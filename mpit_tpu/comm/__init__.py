@@ -1,0 +1,57 @@
+"""mpit_tpu.comm — the in-tree TPU communication backend.
+
+Replaces the reference's L-1/L0/L1 strata (libmpi + the ``mpiT.c`` Lua
+binding + the ``mpiT`` Lua module; SURVEY.md §2) with:
+
+- :mod:`mpit_tpu.comm.mesh` — bootstrap/topology: :func:`init` builds a
+  :class:`World` (a named ``jax.sharding.Mesh`` + process info), the
+  analogue of ``mpiT.Init()`` + ``Comm_rank``/``Comm_size`` — except rank
+  and size come from the device topology (slice metadata / PJRT device
+  list), not from ``mpirun``.
+- :mod:`mpit_tpu.comm.collectives` — the collective API (allreduce,
+  broadcast, reduce, allgather, reduce_scatter, alltoall, permute/shift,
+  barrier, send/recv-style neighbor exchange) as ``shard_map``-friendly
+  functions lowered to XLA collectives over ICI.
+- :mod:`mpit_tpu.comm.pallas_ring` — the native tier: Pallas ring-DMA
+  kernels (double-buffered ``make_async_remote_copy``) for ring
+  all-gather / all-reduce, benchmarked for the "allreduce GB/s" metric.
+"""
+
+from mpit_tpu.comm.mesh import World, init, get_world, local_mesh
+from mpit_tpu.comm.collectives import (
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    permute,
+    pmean,
+    rank,
+    recv_from,
+    reduce,
+    reduce_scatter,
+    send_to,
+    shift,
+    size,
+)
+
+__all__ = [
+    "World",
+    "init",
+    "get_world",
+    "local_mesh",
+    "allreduce",
+    "allgather",
+    "alltoall",
+    "barrier",
+    "broadcast",
+    "permute",
+    "pmean",
+    "rank",
+    "recv_from",
+    "reduce",
+    "reduce_scatter",
+    "send_to",
+    "shift",
+    "size",
+]

@@ -1,0 +1,204 @@
+"""Collective operations — the ``mpiT`` communication API, TPU-native.
+
+Reference capability (SURVEY.md §3.1 C1): the ``mpiT`` Lua module exposes
+``Send/Recv``, ``Isend/Irecv`` (+``Wait``/``Test``), ``Barrier``, ``Bcast``,
+``Reduce``, ``Allreduce`` over Torch tensor memory, each a call into libmpi
+(``MPI_Allreduce`` etc.) crossing a process boundary.
+
+TPU-native redesign: every function here is pure and traceable — it is meant
+to be called *inside* ``jit``/``shard_map`` over a named mesh axis, where XLA
+lowers it to ICI collectives (ring allreduce, all-gather, collective
+permute). Consequences, documented rather than papered over (SURVEY.md §8.4):
+
+- There is no tagged, receiver-driven P2P (``ANY_SOURCE``/``ANY_TAG``): all
+  communication patterns are static at trace time. Structured neighbor
+  exchange (:func:`permute`, :func:`shift`, :func:`send_to`) covers the
+  pipeline/ring cases; the async parameter-server protocol collapses to
+  synchronous collectives (see ``mpit_tpu.compat`` and BASELINE.json's
+  north-star).
+- "Async" (``Isend``/``Irecv``) is the *compiler's* job: XLA overlaps
+  collectives with compute automatically; explicit overlap is available via
+  the Pallas tier (``mpit_tpu.comm.pallas_ring``).
+
+Every function takes ``axis`` — one mesh-axis name or a sequence of them —
+mirroring how an MPI communicator scopes a collective to a process group.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = str | Sequence[str]
+
+_REDUCE_OPS = ("sum", "mean", "max", "min", "prod")
+
+
+def rank(axis: str):
+    """This device's coordinate along ``axis`` — ``mpiT.Comm_rank`` analogue.
+
+    Only meaningful inside ``shard_map``/``jit`` over a mesh with ``axis``.
+    """
+    return lax.axis_index(axis)
+
+
+def size(axis: AxisName):
+    """Number of devices along ``axis`` — ``mpiT.Comm_size`` analogue."""
+    if isinstance(axis, str):
+        return lax.axis_size(axis)
+    out = 1
+    for a in axis:
+        out *= lax.axis_size(a)
+    return out
+
+
+def allreduce(x, axis: AxisName, *, op: str = "sum"):
+    """All-reduce — the ``mpiT.Allreduce`` analogue (the sync-DP primitive).
+
+    Reference: ``MPI_Allreduce(sendbuf, recvbuf, …, MPI_SUM, comm)``
+    (SURVEY.md §4.3). Here: ``lax.psum``/``pmax``/``pmin`` lowered by XLA to
+    an ICI ring; everyone receives the reduced value.
+    """
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    if op == "prod":
+        # No native pprod collective: gather then reduce locally. The final
+        # pmax is numerically a no-op (all devices hold the same product)
+        # but marks the result replicated for shard_map's VMA checker.
+        names = (axis,) if isinstance(axis, str) else tuple(axis)
+        y = x
+        for a in names:
+            y = jnp.prod(lax.all_gather(y, a, axis=0), axis=0)
+            y = lax.pmax(y, a)
+        return y
+    raise ValueError(f"op must be one of {_REDUCE_OPS}, got {op!r}")
+
+
+def pmean(x, axis: AxisName):
+    """Mean-allreduce; the gradient-averaging spelling of :func:`allreduce`."""
+    return lax.pmean(x, axis)
+
+
+def reduce(x, axis: str, *, root: int = 0, op: str = "sum"):
+    """Reduce-to-root — the ``mpiT.Reduce`` analogue.
+
+    MPI leaves non-root buffers undefined; under SPMD every device computes
+    the allreduce and non-root devices get **zeros** (a defined, testable
+    contract). If every device needs the value, use :func:`allreduce`.
+    """
+    y = allreduce(x, axis, op=op)
+    is_root = jnp.broadcast_to(rank(axis) == root, y.shape)
+    return lax.select(is_root, y, jnp.zeros_like(y))
+
+
+def broadcast(x, axis: str, *, root: int = 0):
+    """Broadcast from ``root`` — the ``mpiT.Bcast`` analogue.
+
+    Reference use: initial parameter sync so every worker starts from
+    identical weights (SURVEY.md §4.4; BASELINE.json config #2 "exercises
+    mpiT.Bcast/Allreduce"). Under SPMD replication is usually free (same
+    init PRNG key), but the explicit op is provided for API parity and for
+    genuinely divergent per-device state.
+
+    Implementation: select-then-psum — zero everywhere but ``root``, then
+    sum. ``lax.select`` (not mask-multiply) so NaN/Inf in non-root buffers
+    cannot poison the result. XLA lowers this to a broadcast-shaped
+    collective on ICI.
+    """
+    is_root = jnp.broadcast_to(rank(axis) == root, x.shape)
+    return lax.psum(lax.select(is_root, x, jnp.zeros_like(x)), axis)
+
+
+def allgather(x, axis: str, *, tiled: bool = False, gather_axis: int = 0):
+    """All-gather along a mesh axis.
+
+    ``tiled=False`` stacks a new leading dimension of size ``size(axis)``;
+    ``tiled=True`` concatenates along ``gather_axis``.
+    """
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_axis: int = 0, tiled: bool = True):
+    """Reduce-scatter: the ZeRO-1 gradient-sharding primitive.
+
+    Absent from the reference's API surface but required by the north-star
+    ("goo optimizer state sharded across chips", BASELINE.json): each device
+    receives one reduced shard of ``x`` along ``scatter_axis``.
+    """
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=tiled)
+
+
+def alltoall(x, axis: str, *, split_axis: int, concat_axis: int, tiled: bool = False):
+    """All-to-all — the Ulysses sequence↔head redistribution primitive."""
+    return lax.all_to_all(
+        x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
+    )
+
+
+def permute(x, axis: str, perm: Sequence[tuple[int, int]]):
+    """Collective permute — the static-pattern P2P analogue.
+
+    ``perm`` is a list of ``(source, dest)`` pairs; devices not named as a
+    dest receive zeros. This is the XLA-native replacement for the
+    reference's tagged ``Send/Recv`` in the *structured* cases (pipeline
+    stages, ring neighbors); dynamic ``ANY_SOURCE`` patterns have no SPMD
+    equivalent (SURVEY.md §8.4) and collapse at a higher level instead.
+    """
+    return lax.ppermute(x, axis, perm=list(perm))
+
+
+def shift(x, axis: str, *, offset: int = 1, wrap: bool = True):
+    """Ring shift: device ``i`` receives from ``i - offset`` (mod size).
+
+    The building block of ring pipelines (pipeline parallelism, ring
+    attention). ``wrap=False`` leaves edge devices holding zeros.
+    """
+    n = lax.axis_size(axis)
+    if wrap:
+        perm = [(i, (i + offset) % n) for i in range(n)]
+    else:
+        perm = [(i, i + offset) for i in range(n) if 0 <= i + offset < n]
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def send_to(x, axis: str, dest: Sequence[int]):
+    """Static scatter-send: device ``i`` sends its ``x`` to ``dest[i]``.
+
+    A compiled, dense stand-in for ``mpiT.Send`` where the communication
+    pattern is known at trace time. ``dest`` must be a permutation of
+    ``range(size(axis))``; devices that nobody sends to receive zeros.
+    """
+    n = len(dest)
+    perm = [(i, int(dest[i])) for i in range(n)]
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def recv_from(x, axis: str, src: Sequence[int]):
+    """Static gather-receive: device ``i`` receives ``x`` from ``src[i]``."""
+    n = len(src)
+    perm = [(int(src[i]), i) for i in range(n)]
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def barrier(axis: AxisName, token=None):
+    """Barrier — the ``mpiT.Barrier`` analogue.
+
+    Under SPMD+XLA a standalone barrier is mostly a scheduling fence: this
+    performs a tiny psum and ties it into ``token`` (any array) via
+    ``optimization_barrier`` so the collective cannot be elided or hoisted.
+    Returns ``token`` (or the psum result if no token given).
+    """
+    fence = lax.psum(jnp.ones((), dtype=jnp.int32), axis)
+    if token is None:
+        return fence
+    token, _ = lax.optimization_barrier((token, fence))
+    return token

@@ -1,0 +1,253 @@
+"""Mesh bootstrap and topology discovery — the ``mpiT.Init()`` analogue.
+
+Reference capability (SURVEY.md §3.1 C1, §4.1; BASELINE.json north-star):
+``mpiT.Init()`` joins the MPI world started by ``mpirun`` and
+``mpiT.Comm_rank``/``Comm_size`` discover the process's place in it; a
+rank-role convention then routes each process into ``pserver.lua`` or the
+client training loop.
+
+TPU-native redesign: there are no per-rank roles — the program is SPMD. What
+``init()`` produces instead is a :class:`World`: a named
+``jax.sharding.Mesh`` laid out over the slice's device topology (ICI), plus
+process-level info for multi-host launches. "Rank" and "size" survive as
+*per-device mesh coordinates* (usable inside ``shard_map`` via
+``lax.axis_index``) and as *process* index/count for host-side code.
+
+Multi-host bootstrap: where the reference relied on ``mpirun`` to start P
+processes and assign ranks, a JAX multi-host program is started by the TPU
+pod runtime (one process per host) and coordinates via
+``jax.distributed.initialize()``, which reads slice metadata. ``init()``
+calls it automatically when the environment indicates a multi-host launch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import threading
+from typing import Any, Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical mesh-axis names used across the framework. A World may use any
+# subset; 'data' is the default (pure-DP, the reference's only strategy).
+DATA_AXIS = "data"      # data parallel (the reference's async/sync DP)
+FSDP_AXIS = "fsdp"      # parameter/optimizer sharding (ZeRO / goo sharding)
+MODEL_AXIS = "model"    # tensor parallel
+PIPE_AXIS = "pipe"      # pipeline parallel
+SEQ_AXIS = "seq"        # sequence / context parallel (ring attention, Ulysses)
+EXPERT_AXIS = "expert"  # expert parallel (MoE)
+
+
+@dataclasses.dataclass(frozen=True)
+class World:
+    """A process's view of the distributed machine: the ``MPI_COMM_WORLD``
+    analogue, re-expressed as a named device mesh.
+
+    Where the reference exposes ``Comm_rank``/``Comm_size`` per *process*
+    (SURVEY.md §4.1), a World exposes:
+
+    - :attr:`mesh` — the named ``jax.sharding.Mesh`` over all addressable
+      devices; collectives ride its axes.
+    - :attr:`process_index` / :attr:`process_count` — host-level identity
+      (what ``mpirun`` rank/size degenerate to under SPMD).
+    - per-device coordinates — available *inside* jitted code via
+      ``comm.rank(axis)`` (= ``lax.axis_index``).
+    """
+
+    mesh: Mesh
+
+    # ----- topology queries ------------------------------------------------
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def shape(self) -> Mapping[str, int]:
+        return dict(self.mesh.shape)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.mesh.shape.values())
+
+    def axis_size(self, axis: str) -> int:
+        return self.mesh.shape[axis]
+
+    @property
+    def process_index(self) -> int:
+        """Host-process rank (the ``mpirun`` rank analogue for host code)."""
+        return jax.process_index()
+
+    @property
+    def process_count(self) -> int:
+        return jax.process_count()
+
+    @property
+    def devices(self) -> np.ndarray:
+        return self.mesh.devices
+
+    def local_devices(self) -> list[Any]:
+        return [d for d in self.mesh.devices.flat if d.process_index == jax.process_index()]
+
+    # ----- sharding helpers ------------------------------------------------
+    def sharding(self, *spec: Any) -> NamedSharding:
+        """NamedSharding over this world's mesh for a PartitionSpec."""
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_map(self, fn, in_specs, out_specs, *, check_vma: bool = True):
+        """``jax.shard_map`` bound to this world's mesh."""
+        return jax.shard_map(
+            fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+
+    # ----- convenience eager collectives (host-level tier) -----------------
+    # These run a one-off shard_map over the mesh. They exist for tests,
+    # benchmarks and the compat facade; hot paths should call the in-jit
+    # functions from mpit_tpu.comm.collectives directly.
+    def allreduce(self, x, *, axis: str | Sequence[str] | None = None, op: str = "sum"):
+        """Reduce a global array whose leading dim is the "rank" dimension.
+
+        ``x.shape[0]`` must be divisible by the total size of the reduce
+        axes; it is sharded across all of them so each element is counted
+        exactly once.
+        """
+        from mpit_tpu.comm import collectives as C
+
+        axes = self.axis_names if axis is None else (
+            (axis,) if isinstance(axis, str) else tuple(axis)
+        )
+        f = self.shard_map(
+            lambda v: C.allreduce(v, axes, op=op), in_specs=P(axes), out_specs=P()
+        )
+        return f(x)
+
+    def __repr__(self) -> str:  # readable in logs
+        shape = ",".join(f"{k}={v}" for k, v in self.mesh.shape.items())
+        return (
+            f"World(mesh=[{shape}], devices={self.num_devices}, "
+            f"process={jax.process_index()}/{jax.process_count()})"
+        )
+
+
+_DEFAULT_WORLD: World | None = None
+_LOCK = threading.Lock()
+_DISTRIBUTED_TRIED = False
+
+
+def _maybe_distributed_initialize() -> None:
+    """Join the multi-host world if the environment indicates one.
+
+    The reference reads rank/size assigned by ``mpirun`` (SURVEY.md §4.1);
+    the TPU-native path reads slice metadata via
+    ``jax.distributed.initialize()``. Single-host (including this build
+    environment's 1-chip axon device and CPU fake meshes) skips it.
+
+    Checked via env vars only — ``jax.distributed.initialize()`` must run
+    before anything initializes the local XLA backends, so no jax topology
+    query may happen first.
+    """
+    global _DISTRIBUTED_TRIED
+    if _DISTRIBUTED_TRIED:
+        return
+    _DISTRIBUTED_TRIED = True
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    n_proc = os.environ.get("JAX_NUM_PROCESSES")
+    if coord and n_proc:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(n_proc),
+                process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
+            )
+        except RuntimeError:
+            pass  # already initialized (e.g. by the launcher)
+
+
+def init(
+    axis_shapes: Mapping[str, int] | None = None,
+    *,
+    devices: Sequence[Any] | None = None,
+    set_default: bool = True,
+) -> World:
+    """Bootstrap the communication backend — the ``mpiT.Init()`` analogue.
+
+    Args:
+      axis_shapes: ordered mapping of mesh-axis name → size, e.g.
+        ``{"data": 4, "model": 2}``. A ``-1`` size (at most one) is
+        inferred from the device count. Default: all devices on one
+        ``"data"`` axis — the pure data-parallel world matching the
+        reference's capability.
+      devices: explicit device list (default: all addressable devices, in
+        the topology-aware order chosen by ``jax.make_mesh``).
+      set_default: install the result as the process-default World
+        returned by :func:`get_world`.
+
+    Returns:
+      A :class:`World`.
+    """
+    _maybe_distributed_initialize()
+    devs = list(devices) if devices is not None else jax.devices()
+    ndev = len(devs)
+
+    if axis_shapes is None:
+        axis_shapes = {DATA_AXIS: ndev}
+    axis_shapes = dict(axis_shapes)
+
+    # Resolve a single -1 wildcard.
+    wild = [k for k, v in axis_shapes.items() if v == -1]
+    if len(wild) > 1:
+        raise ValueError(f"at most one -1 axis allowed, got {wild}")
+    if wild:
+        known = math.prod(v for v in axis_shapes.values() if v != -1)
+        if ndev % known:
+            raise ValueError(
+                f"device count {ndev} not divisible by fixed axes product {known}"
+            )
+        axis_shapes[wild[0]] = ndev // known
+    if math.prod(axis_shapes.values()) != ndev:
+        raise ValueError(
+            f"mesh shape {axis_shapes} does not cover {ndev} devices"
+        )
+
+    # AxisType.Auto throughout: this framework is shard_map-centric, and
+    # jax 0.9's make_mesh default of Explicit leaks sharding-in-types avals
+    # into host-level ops outside a mesh context.
+    axis_types = (jax.sharding.AxisType.Auto,) * len(axis_shapes)
+    if devices is None:
+        # Topology-aware layout (ICI-friendly): jax.make_mesh reorders
+        # devices so the innermost axes land on physical neighbors.
+        mesh = jax.make_mesh(
+            tuple(axis_shapes.values()), tuple(axis_shapes.keys()), axis_types
+        )
+    else:
+        dev_array = np.asarray(devs).reshape(tuple(axis_shapes.values()))
+        mesh = Mesh(dev_array, tuple(axis_shapes.keys()), axis_types=axis_types)
+
+    world = World(mesh=mesh)
+    if set_default:
+        global _DEFAULT_WORLD
+        with _LOCK:
+            _DEFAULT_WORLD = world
+    return world
+
+
+def get_world() -> World:
+    """Return the process-default World, creating a pure-DP one on demand."""
+    global _DEFAULT_WORLD
+    if _DEFAULT_WORLD is None:
+        init()
+    assert _DEFAULT_WORLD is not None
+    return _DEFAULT_WORLD
+
+
+def local_mesh(axis_shapes: Mapping[str, int] | None = None) -> Mesh:
+    """Shorthand: build a mesh without installing a default World."""
+    return init(axis_shapes, set_default=False).mesh

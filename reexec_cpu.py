@@ -1,0 +1,42 @@
+"""Early pytest plugin: re-exec the test run onto a fake 8-device CPU mesh.
+
+Loaded via ``pytest.ini`` ``addopts = -p reexec_cpu`` so it runs at plugin-
+registration time — BEFORE pytest's fd-level capture starts — which keeps
+the re-exec'd child's output on the real stdout. (``tests/conftest.py`` has
+a fallback for runs that bypass pytest.ini, but by then capture has started
+and the child's output is swallowed; this plugin is the primary path.)
+
+Why re-exec at all: this environment's sitecustomize eagerly registers and
+initializes the single-chip ``axon`` TPU backend in every Python process, so
+in-process env changes are too late. The collective/sharding test suite
+needs the fake 8-device CPU mesh (SURVEY.md §5.2) — the analogue of the
+reference running MPI locally under ``mpirun -n 2..4`` (SURVEY.md §5.1).
+
+Set ``MPIT_TEST_PLATFORM=axon`` to run on the real chip instead.
+"""
+
+import os
+import sys
+
+N_FAKE_DEVICES = 8
+
+
+def reexec_onto_cpu_mesh_if_needed() -> None:
+    if os.environ.get("MPIT_TEST_REEXEC") == "1":
+        return
+    if os.environ.get("MPIT_TEST_PLATFORM", "cpu") != "cpu":
+        return
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disables axon registration
+    env["JAX_PLATFORMS"] = "cpu"
+    xla_flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        xla_flags += f" --xla_force_host_platform_device_count={N_FAKE_DEVICES}"
+    env["XLA_FLAGS"] = xla_flags.strip()
+    env["MPIT_TEST_REEXEC"] = "1"
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
+
+
+reexec_onto_cpu_mesh_if_needed()

@@ -1,0 +1,203 @@
+"""Tests for mpit_tpu.comm — the collective API on the fake 8-device mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §5.1): small scripts that
+exercise send/recv and collectives between ranks, with MPI-run-locally
+replaced by the forced 8-device CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mpit_tpu import comm
+from mpit_tpu.comm import collectives as C
+
+
+def _per_rank(world, fn, x, in_spec=P("data"), out_spec=P("data")):
+    """Run fn per-shard over the world's 'data' axis."""
+    return world.shard_map(fn, in_specs=in_spec, out_specs=out_spec)(x)
+
+
+class TestInit:
+    def test_init_default_mesh(self, world8):
+        assert world8.axis_names == ("data",)
+        assert world8.num_devices == jax.device_count()
+        assert world8.process_index == 0
+
+    def test_init_2d(self, world_2d):
+        assert world_2d.shape == {"data": 4, "model": 2}
+
+    def test_init_wildcard(self):
+        w = comm.init({"data": -1, "model": 2}, set_default=False)
+        assert w.shape["data"] * 2 == jax.device_count()
+
+    def test_init_bad_shape(self):
+        with pytest.raises(ValueError):
+            comm.init({"data": 3}, set_default=False)
+
+    def test_get_world_default(self):
+        w = comm.get_world()
+        assert isinstance(w, comm.World)
+
+
+class TestCollectives:
+    def test_rank_size(self, world8):
+        n = world8.num_devices
+        x = jnp.zeros((n, 1))
+
+        def body(_):
+            return (C.rank("data") + 0 * C.size("data"))[None, None]
+
+        got = _per_rank(world8, body, x)
+        np.testing.assert_array_equal(np.asarray(got).ravel(), np.arange(n))
+
+    def test_allreduce_sum_exact(self, world8):
+        # Allreduce-sum exactness: parity with single-process numpy
+        # (SURVEY.md §5.2 parity tests).
+        n = world8.num_devices
+        rng = np.random.RandomState(0)
+        x = rng.randn(n, 16).astype(np.float32)
+        got = _per_rank(
+            world8, lambda v: C.allreduce(v, "data"), jnp.asarray(x), P("data"), P()
+        )
+        np.testing.assert_allclose(np.asarray(got), x.sum(0, keepdims=True), rtol=1e-5)
+
+    @pytest.mark.parametrize("op", ["mean", "max", "min", "prod"])
+    def test_allreduce_ops(self, world8, op):
+        n = world8.num_devices
+        rng = np.random.RandomState(1)
+        x = rng.rand(n, 8).astype(np.float32) + 0.5
+        got = _per_rank(
+            world8, lambda v: C.allreduce(v, "data", op=op), jnp.asarray(x), P("data"), P()
+        )
+        ref = getattr(np, op if op != "mean" else "mean")(x, axis=0, keepdims=True)
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5)
+
+    def test_broadcast(self, world8):
+        n = world8.num_devices
+        x = np.arange(n, dtype=np.float32).reshape(n, 1) + 7.0
+        got = _per_rank(
+            world8, lambda v: C.broadcast(v, "data", root=3), jnp.asarray(x)
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.full((n, 1), 10.0))
+
+    def test_reduce_root_only(self, world8):
+        n = world8.num_devices
+        x = np.ones((n, 1), np.float32)
+        got = _per_rank(
+            world8, lambda v: C.reduce(v, "data", root=2), jnp.asarray(x)
+        )
+        expect = np.zeros((n, 1), np.float32)
+        expect[2] = n
+        np.testing.assert_array_equal(np.asarray(got), expect)
+
+    def test_allgather(self, world8):
+        n = world8.num_devices
+        x = np.arange(n, dtype=np.float32).reshape(n, 1)
+        got = _per_rank(
+            world8,
+            lambda v: C.allgather(v, "data", tiled=True)[None],
+            jnp.asarray(x),
+        )
+        # every rank holds the full gathered vector
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(n, n),
+            np.tile(np.arange(n, dtype=np.float32), (n, 1)),
+        )
+
+    def test_reduce_scatter_matches_allreduce_shard(self, world8):
+        n = world8.num_devices
+        rng = np.random.RandomState(2)
+        x = rng.randn(n, n * 4).astype(np.float32)
+
+        def body(v):
+            return C.reduce_scatter(v[0], "data")[None]
+
+        got = _per_rank(world8, body, jnp.asarray(x))
+        expect = x.sum(0).reshape(n, 4)
+        np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-5)
+
+    def test_shift_ring(self, world8):
+        n = world8.num_devices
+        x = np.arange(n, dtype=np.float32).reshape(n, 1)
+        got = _per_rank(world8, lambda v: C.shift(v, "data", offset=1), jnp.asarray(x))
+        np.testing.assert_array_equal(
+            np.asarray(got).ravel(), np.roll(np.arange(n), 1)
+        )
+
+    def test_send_to_recv_from_roundtrip(self, world8):
+        n = world8.num_devices
+        dest = [(i + 3) % n for i in range(n)]
+        x = np.arange(n, dtype=np.float32).reshape(n, 1)
+        sent = _per_rank(
+            world8, lambda v: C.send_to(v, "data", dest), jnp.asarray(x)
+        )
+        # device dest[i] now holds i
+        expect = np.zeros(n)
+        for i in range(n):
+            expect[dest[i]] = i
+        np.testing.assert_array_equal(np.asarray(sent).ravel(), expect)
+        back = _per_rank(
+            world8, lambda v: C.recv_from(v, "data", dest), jnp.asarray(sent)
+        )
+        # recv_from(src=dest) pulls back: device i receives from dest[i]
+        np.testing.assert_array_equal(np.asarray(back).ravel(), np.arange(n))
+
+    def test_alltoall(self, world8):
+        n = world8.num_devices
+        x = np.arange(n * n, dtype=np.float32).reshape(n, n, 1)
+
+        def body(v):
+            return C.alltoall(v[0], "data", split_axis=0, concat_axis=0)[None]
+
+        got = _per_rank(world8, body, jnp.asarray(x))
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(n, n), np.arange(n * n).reshape(n, n).T
+        )
+
+    def test_barrier_passthrough(self, world8):
+        x = jnp.arange(8.0).reshape(8, 1)
+        got = _per_rank(
+            world8, lambda v: C.barrier("data", token=v), x
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(x))
+
+    def test_broadcast_ignores_nan_in_nonroot(self, world8):
+        # Non-root buffers may be garbage (NaN/Inf); Bcast must still
+        # deliver the root's value everywhere.
+        n = world8.num_devices
+        x = np.full((n, 2), np.nan, np.float32)
+        x[3] = 42.0
+        got = _per_rank(
+            world8, lambda v: C.broadcast(v, "data", root=3), jnp.asarray(x)
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.full((n, 2), 42.0))
+
+    def test_multi_axis_allreduce(self, world_2d):
+        mesh_size = world_2d.num_devices
+        x = jnp.ones((4, 2))
+        f = world_2d.shard_map(
+            lambda v: C.allreduce(v, ("data", "model")),
+            in_specs=P("data", "model"),
+            out_specs=P(),
+        )
+        got = f(x)
+        np.testing.assert_array_equal(np.asarray(got), np.full((1, 1), mesh_size))
+
+
+class TestEagerTier:
+    def test_world_allreduce(self, world8):
+        n = world8.num_devices
+        x = jnp.arange(float(n))
+        got = world8.allreduce(x)
+        np.testing.assert_allclose(float(np.asarray(got)[0]), n * (n - 1) / 2)
+
+    def test_world_allreduce_multi_axis_counts_once(self, world_2d):
+        # Regression: each element must be counted exactly once on a
+        # multi-axis mesh (leading dim sharded over ALL reduce axes).
+        n = world_2d.num_devices
+        x = jnp.ones((n, 3))
+        got = world_2d.allreduce(x)
+        np.testing.assert_array_equal(np.asarray(got), np.full((1, 3), n))
