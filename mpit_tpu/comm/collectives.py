@@ -32,6 +32,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# Varying→invariant all-gather: the result is identical on every device and
+# is *marked* replicated for shard_map's VMA checker (plain lax.all_gather
+# returns a varying-typed value). Public in spirit; lives in _src in jax 0.9.
+from jax._src.lax.parallel import all_gather_invariant as _all_gather_invariant
+
 AxisName = str | Sequence[str]
 
 _REDUCE_OPS = ("sum", "mean", "max", "min", "prod")
@@ -71,14 +76,12 @@ def allreduce(x, axis: AxisName, *, op: str = "sum"):
     if op == "min":
         return lax.pmin(x, axis)
     if op == "prod":
-        # No native pprod collective: gather then reduce locally. The final
-        # pmax is numerically a no-op (all devices hold the same product)
-        # but marks the result replicated for shard_map's VMA checker.
+        # No native pprod collective: invariant-gather then reduce locally
+        # (identical on every device, typed replicated).
         names = (axis,) if isinstance(axis, str) else tuple(axis)
         y = x
         for a in names:
-            y = jnp.prod(lax.all_gather(y, a, axis=0), axis=0)
-            y = lax.pmax(y, a)
+            y = jnp.prod(_all_gather_invariant(y, a, axis=0), axis=0)
         return y
     raise ValueError(f"op must be one of {_REDUCE_OPS}, got {op!r}")
 
@@ -110,20 +113,33 @@ def broadcast(x, axis: str, *, root: int = 0):
     genuinely divergent per-device state.
 
     Implementation: select-then-psum — zero everywhere but ``root``, then
-    sum. ``lax.select`` (not mask-multiply) so NaN/Inf in non-root buffers
-    cannot poison the result. XLA lowers this to a broadcast-shaped
-    collective on ICI.
+    sum (``lax.select``, not mask-multiply, so garbage NaN/Inf in non-root
+    buffers cannot poison the result). ``lax.pbroadcast`` (the
+    CollectiveBroadcast HLO) was evaluated and rejected: jax 0.9 has no
+    MLIR lowering for it on either the CPU test mesh *or* this TPU stack.
     """
     is_root = jnp.broadcast_to(rank(axis) == root, x.shape)
     return lax.psum(lax.select(is_root, x, jnp.zeros_like(x)), axis)
 
 
-def allgather(x, axis: str, *, tiled: bool = False, gather_axis: int = 0):
+def allgather(
+    x,
+    axis: str,
+    *,
+    tiled: bool = False,
+    gather_axis: int = 0,
+    invariant: bool = False,
+):
     """All-gather along a mesh axis.
 
     ``tiled=False`` stacks a new leading dimension of size ``size(axis)``;
-    ``tiled=True`` concatenates along ``gather_axis``.
+    ``tiled=True`` concatenates along ``gather_axis``. ``invariant=True``
+    types the (identical-everywhere) result as replicated for shard_map's
+    VMA checker — use when the gathered value leaves the shard_map with a
+    replicated out_spec.
     """
+    if invariant:
+        return _all_gather_invariant(x, axis, axis=gather_axis, tiled=tiled)
     return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
 
 

@@ -1,0 +1,31 @@
+"""mpit_tpu.opt — the "goo" optimizer family, TPU-native.
+
+Reference capability (SURVEY.md §3.1 A3): the ``goo`` optimizer module
+(``asyncsgd/goo*.lua``) holds the server-side update rule — learning rate,
+momentum, and the EASGD elastic term — applied to the flattened parameter
+vector held by ``pserver.lua``.
+
+TPU-native redesign:
+
+- :mod:`mpit_tpu.opt.goo` — the update rules as optax-compatible
+  ``GradientTransformation``s (Torch-`optim.sgd` semantics for parity with
+  the Torch7 reference, plus the elastic-averaging EASGD dynamics).
+- :mod:`mpit_tpu.opt.sharded` — the north-star requirement
+  ("goo optimizer state sharded across chips", BASELINE.json): ZeRO-1-style
+  cross-replica sharding of any gradient transformation — reduce-scatter
+  grads → update the local shard of params+state → all-gather params
+  (cf. arXiv:2004.13336, PAPERS.md).
+"""
+
+from mpit_tpu.opt.goo import GooState, elastic_average, goo, goo_adam
+from mpit_tpu.opt.sharded import sharded, sharded_init, sharded_update
+
+__all__ = [
+    "goo",
+    "goo_adam",
+    "GooState",
+    "elastic_average",
+    "sharded",
+    "sharded_init",
+    "sharded_update",
+]

@@ -1,0 +1,171 @@
+"""The "goo" update rules as optax-compatible gradient transformations.
+
+Reference capability (SURVEY.md §3.1 A3): ``asyncsgd/goo*.lua`` implements
+the server-side SGD step — plain SGD, momentum, and the elastic-averaging
+(EASGD) variant that is the reference's distinctive feature (Zhang,
+Choromanska & LeCun, NIPS 2015, arXiv:1412.6651).
+
+Design choices:
+
+- **Optax protocol.** Every rule is an ``optax.GradientTransformation``
+  (``init(params) -> state``; ``update(grads, state, params) -> (updates,
+  state)``), so goo composes with the whole optax ecosystem and with
+  :mod:`mpit_tpu.opt.sharded`'s ZeRO-1 wrapper.
+- **Torch semantics.** The reference is Torch7; :func:`goo` reproduces
+  Torch's ``optim.sgd`` update exactly (momentum buffer
+  ``b ← μ·b + (1-damp)·g``, Nesterov ``g + μ·b``, weight decay added to the
+  raw gradient) so trajectories can be parity-tested against
+  ``torch.optim.SGD`` (tests/test_goo.py does).
+- **EASGD as a transform.** :func:`elastic_average` keeps the center
+  variable x̃ as optimizer state. In the distributed setting each worker's
+  params *vary* along a mesh axis (local-SGD style) while the center is the
+  cross-worker mean — the reference's two-actor protocol re-expressed as a
+  single SPMD-pure update (BASELINE.json north-star).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+
+class GooState(NamedTuple):
+    """Momentum buffers for :func:`goo` (empty tuple when momentum=0)."""
+
+    momentum: optax.Updates
+
+
+class ElasticState(NamedTuple):
+    """EASGD center variable x̃ — the pserver's canonical params."""
+
+    center: optax.Params
+
+
+def goo(
+    lr: float,
+    momentum: float = 0.0,
+    *,
+    nesterov: bool = False,
+    weight_decay: float = 0.0,
+    dampening: float = 0.0,
+) -> optax.GradientTransformation:
+    """Torch-``optim.sgd``-semantics SGD — the reference's goo update.
+
+    Update (matching Torch7/PyTorch exactly, for parity tests):
+
+        g ← g + weight_decay·p
+        b ← momentum·b + (1 − dampening)·g        (b initialized to g)
+        g ← g + momentum·b   if nesterov else b
+        p ← p − lr·g
+
+    Returns an optax ``GradientTransformation`` producing *updates*
+    (``−lr·g``) to be applied with ``optax.apply_updates``.
+    """
+
+    def init(params):
+        if momentum == 0.0:
+            return GooState(momentum=())
+        return GooState(momentum=jax.tree.map(jnp.zeros_like, params))
+
+    def update(grads, state, params=None):
+        if weight_decay != 0.0:
+            if params is None:
+                raise ValueError("goo(weight_decay != 0) requires params")
+            grads = jax.tree.map(lambda g, p: g + weight_decay * p, grads, params)
+        if momentum == 0.0:
+            updates = jax.tree.map(lambda g: -lr * g, grads)
+            return updates, state
+
+        # Buffers seed at zero, so the first step gives b = (1-damp)·g.
+        # Torch special-cases the first step to b = g; with dampening=0
+        # (the reference's setting) the two are identical, and that is the
+        # configuration the torch parity test pins down. For dampening≠0
+        # only the first step differs (documented deviation).
+        buf = jax.tree.map(
+            lambda b, g: momentum * b + (1.0 - dampening) * g,
+            state.momentum,
+            grads,
+        )
+        if nesterov:
+            step = jax.tree.map(lambda g, b: g + momentum * b, grads, buf)
+        else:
+            step = buf
+        updates = jax.tree.map(lambda s: -lr * s, step)
+        return updates, GooState(momentum=buf)
+
+    return optax.GradientTransformation(init, update)
+
+
+def goo_adam(
+    lr: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Adam(W) spelled as a goo rule — not in the reference (its goo is SGD
+    family; SURVEY.md §3.1 A3) but required by the GPT-2 stretch config."""
+    if weight_decay:
+        return optax.adamw(lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+    return optax.adam(lr, b1=b1, b2=b2, eps=eps)
+
+
+def elastic_average(
+    alpha: float,
+    beta: float | None = None,
+    *,
+    axis: str | None = None,
+) -> optax.GradientTransformation:
+    """EASGD elastic term — the reference's distinctive dynamics.
+
+    Reference protocol (SURVEY.md §4.2): each worker periodically exchanges
+    an elastic difference with the pserver's center variable x̃:
+
+        worker:  x_i ← x_i − α·(x_i − x̃)         (on top of its SGD step)
+        server:  x̃  ← x̃ + β·(x̄ − x̃)             (x̄ = mean over workers)
+
+    TPU-native collapse: this transform is *chained after* a base rule (e.g.
+    ``optax.chain(goo(lr), elastic_average(alpha, axis="data"))``) inside a
+    ``shard_map`` where params vary along ``axis`` (each device = one
+    worker). The center x̃ lives in optimizer state, replicated; the mean x̄
+    is one ``lax.pmean`` — the whole pserver actor reduced to a collective.
+
+    With ``axis=None`` (single worker) x̄ = x_i and the dynamics reduce to
+    the two-body attraction of worker and center.
+
+    Args:
+      alpha: worker-side elastic coefficient (attraction to center).
+      beta: center-side step toward the worker mean; default ``alpha``
+        (symmetric coupling, the paper's stability condition is
+        β = N·α for N workers with per-worker α — pass it explicitly for
+        paper-exact dynamics).
+      axis: mesh axis naming the worker group, or None.
+    """
+    beta_ = alpha if beta is None else beta
+
+    def init(params):
+        return ElasticState(center=jax.tree.map(jnp.asarray, params))
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("elastic_average requires params")
+        # Worker pull toward center, applied on top of incoming updates.
+        pulled = jax.tree.map(
+            lambda u, p, c: u - alpha * (p - c), updates, params, state.center
+        )
+        # Post-step worker params (what the center should average over).
+        new_params = jax.tree.map(lambda p, u: p + u, params, pulled)
+        if axis is not None:
+            mean_params = jax.tree.map(lambda p: lax.pmean(p, axis), new_params)
+        else:
+            mean_params = new_params
+        new_center = jax.tree.map(
+            lambda c, m: c + beta_ * (m - c), state.center, mean_params
+        )
+        return pulled, ElasticState(center=new_center)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,185 @@
+"""ZeRO-1-style cross-replica sharding of the optimizer update.
+
+The north-star requirement (BASELINE.json): "the goo optimizer state sharded
+across chips". The reference's pserver holds the full flattened parameter
+vector and optimizer state on one process (SURVEY.md §3.1 A1/A3); here every
+device holds ``1/N`` of the flattened state and the update choreography is
+(cf. arXiv:2004.13336, PAPERS.md):
+
+    reduce-scatter(grads) → update own shard (params + opt state) →
+    all-gather(params)
+
+which costs the same bandwidth as a plain allreduce (reduce-scatter +
+all-gather IS a ring allreduce, split around the update) while dividing
+optimizer memory by N.
+
+Like the reference's flat-tensor design (Torch's flattened parameters), the
+pytree is raveled to one 1-D vector, padded to a multiple of the axis size,
+and sharded contiguously. The update rule is elementwise, so flat layout
+costs nothing on the MXU and keeps shard boundaries trivial.
+
+All functions here run *inside* ``shard_map`` (state is per-device = truly
+sharded). :func:`sharded_init`/:func:`sharded_update` are host-level
+conveniences that wrap the shard_map for you.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import PartitionSpec as P
+
+from mpit_tpu.comm import collectives as C
+
+
+def _pad_to(x: jax.Array, multiple: int) -> jax.Array:
+    rem = (-x.shape[0]) % multiple
+    if rem:
+        x = jnp.concatenate([x, jnp.zeros((rem,), x.dtype)])
+    return x
+
+
+def sharded(
+    tx: optax.GradientTransformation,
+    axis: str,
+    *,
+    mean_grads: bool = True,
+) -> optax.GradientTransformation:
+    """Wrap ``tx`` so its state lives sharded along mesh ``axis``.
+
+    Both ``init`` and ``update`` must be called inside ``shard_map`` over
+    ``axis``:
+
+    - ``init(params)`` (params replicated) → per-device state = ``tx.init``
+      of this device's contiguous shard of the flat parameter vector.
+    - ``update(grads, state, params)`` takes the *local, unreduced* grads:
+      the cross-replica sum rides the reduce-scatter (one collective doing
+      both the reduction and the sharding — cheaper than psum-then-slice).
+      Returns full (replicated) updates via all-gather, optax-style.
+
+    ``mean_grads=True`` averages (divides the scattered sum by the axis
+    size) — the sync-DP convention; ``False`` sums, matching the
+    reference's gradient-push accumulation semantics.
+    """
+
+    def _shard_of(flat: jax.Array):
+        n = lax.axis_size(axis)
+        padded = _pad_to(flat, n)
+        s = padded.shape[0] // n
+        return lax.dynamic_slice(padded, (lax.axis_index(axis) * s,), (s,))
+
+    def init(params):
+        flat, _ = ravel_pytree(params)
+        return tx.init(_shard_of(flat))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("sharded(tx) requires params")
+        n = lax.axis_size(axis)
+        flat_g, unravel = ravel_pytree(grads)
+        size = flat_g.shape[0]
+        # reduce-scatter: each device receives the summed shard it owns.
+        g_shard = C.reduce_scatter(_pad_to(flat_g, n), axis)
+        if mean_grads:
+            g_shard = g_shard / n
+        flat_p, _ = ravel_pytree(params)
+        p_shard = _shard_of(flat_p)
+        u_shard, new_state = tx.update(g_shard, state, p_shard)
+        # invariant gather: updates are identical everywhere and typed
+        # replicated, so they can exit shard_map with a replicated spec.
+        flat_u = C.allgather(u_shard, axis, tiled=True, invariant=True)[:size]
+        return unravel(flat_u), new_state
+
+    return optax.GradientTransformation(init, update)
+
+
+def state_partition_specs(
+    tx: optax.GradientTransformation, params, n: int, axis: str
+):
+    """PartitionSpecs for the sharded state of ``tx`` over ``n`` devices.
+
+    Per-shard vector leaves → ``P(axis)``; scalar leaves (step counts etc.,
+    identical on every device) → replicated. Computed by abstract-evaluating
+    one device's ``tx.init`` on a zero shard — no mesh required.
+    """
+
+    def one_device_init(p):
+        flat, _ = ravel_pytree(p)
+        padded_len = flat.shape[0] + ((-flat.shape[0]) % n)
+        return tx.init(jnp.zeros((padded_len // n,), flat.dtype))
+
+    shapes = jax.eval_shape(one_device_init, params)
+    return jax.tree.map(
+        lambda l: P(axis) if getattr(l, "ndim", 0) >= 1 else P(), shapes
+    )
+
+
+# Compiled-update cache for the host-level helpers: a fresh shard_map per
+# call would retrace/recompile every step (observed: 200 eager steps taking
+# minutes on the fake mesh). Keyed by (mesh, axis, tx identity, arg shapes)
+# — so CONSTRUCT THE TRANSFORMATION ONCE AND REUSE IT across steps; a fresh
+# goo(...) per call defeats the cache (optax transformations carry their
+# config in closures, leaving id() as the only usable identity). Bounded
+# LRU so per-call construction degrades to recompilation, not a leak.
+_COMPILED: OrderedDict = OrderedDict()
+_COMPILED_MAX = 32
+
+
+def _cache_key(world, tx, axis, *trees):
+    shapes = tuple(
+        (jax.tree_util.tree_structure(t) if t is not None else None,
+         tuple((l.shape, str(l.dtype)) for l in jax.tree.leaves(t)))
+        for t in trees
+    )
+    return (world.mesh, id(tx), axis, shapes)
+
+
+def sharded_init(
+    world, tx: optax.GradientTransformation, params, *, axis: str = "data"
+):
+    """Host-level: build optimizer state sharded along ``axis`` of
+    ``world``'s mesh (params replicated in)."""
+    stx = sharded(tx, axis)
+    specs = state_partition_specs(tx, params, world.axis_size(axis), axis)
+    return world.shard_map(stx.init, in_specs=P(), out_specs=specs)(params)
+
+
+def sharded_update(
+    world,
+    tx: optax.GradientTransformation,
+    grads,
+    state,
+    params,
+    *,
+    axis: str = "data",
+):
+    """Host-level: one sharded update step on a *global* (replicated) grad.
+
+    Semantics: apply ``tx`` to exactly the given grads (the reduce-scatter
+    sums N replicated copies; the default ``mean_grads`` divides them back).
+    The in-jit training step should use :func:`sharded` directly with local
+    per-device grads instead — that is the bandwidth-efficient path.
+
+    Returns ``(updates, new_state)`` with updates replicated, optax-style.
+    """
+    key = _cache_key(world, tx, axis, grads, params)
+    f = _COMPILED.get(key)
+    if f is None:
+        stx = sharded(tx, axis, mean_grads=True)
+        specs = state_partition_specs(tx, params, world.axis_size(axis), axis)
+        f = jax.jit(
+            world.shard_map(
+                stx.update, in_specs=(P(), specs, P()), out_specs=(P(), specs)
+            )
+        )
+        _COMPILED[key] = f
+        while len(_COMPILED) > _COMPILED_MAX:
+            _COMPILED.popitem(last=False)
+    else:
+        _COMPILED.move_to_end(key)
+    return f(grads, state, params)

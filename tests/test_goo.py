@@ -1,0 +1,213 @@
+"""Tests for mpit_tpu.opt — goo parity vs torch, EASGD dynamics, ZeRO-1.
+
+Parity strategy (SURVEY.md §5.2): single-process references (torch.optim.SGD
+on CPU, closed-form numpy EASGD simulation) vs the distributed result on the
+fake 8-device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mpit_tpu import comm
+from mpit_tpu import opt as gopt
+
+
+def tree_close(a, b, rtol=1e-5, atol=1e-6):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=atol
+        ),
+        a,
+        b,
+    )
+
+
+class TestGooVsTorch:
+    """goo reproduces torch.optim.SGD trajectories exactly (the reference's
+    goo is Torch7 SGD-family; SURVEY.md §3.1 A3)."""
+
+    @pytest.mark.parametrize(
+        "momentum,nesterov,weight_decay",
+        [(0.0, False, 0.0), (0.9, False, 0.0), (0.9, True, 0.0), (0.9, False, 1e-2)],
+    )
+    def test_quadratic_trajectory(self, momentum, nesterov, weight_decay):
+        import torch
+
+        lr = 0.1
+        target = np.array([1.0, -2.0, 3.0], np.float32)
+        w0 = np.zeros(3, np.float32)
+
+        # torch reference
+        wt = torch.tensor(w0.copy(), requires_grad=True)
+        topt = torch.optim.SGD(
+            [wt], lr=lr, momentum=momentum, nesterov=nesterov, weight_decay=weight_decay
+        )
+        torch_traj = []
+        for _ in range(10):
+            topt.zero_grad()
+            loss = 0.5 * ((wt - torch.tensor(target)) ** 2).sum()
+            loss.backward()
+            topt.step()
+            torch_traj.append(wt.detach().numpy().copy())
+
+        # goo
+        tx = gopt.goo(lr, momentum, nesterov=nesterov, weight_decay=weight_decay)
+        w = jnp.asarray(w0)
+        state = tx.init(w)
+        loss_fn = lambda p: 0.5 * jnp.sum((p - target) ** 2)
+        for i in range(10):
+            g = jax.grad(loss_fn)(w)
+            updates, state = tx.update(g, state, w)
+            w = optax.apply_updates(w, updates)
+            np.testing.assert_allclose(
+                np.asarray(w), torch_traj[i], rtol=1e-5, atol=1e-6
+            )
+
+
+class TestElasticAverage:
+    def test_single_worker_two_body(self):
+        # With axis=None: worker and center attract; closed-form numpy sim.
+        alpha, beta, lr = 0.3, 0.2, 0.1
+        target = 5.0
+        tx = optax.chain(gopt.goo(lr), gopt.elastic_average(alpha, beta))
+        w = jnp.array([0.0])
+        state = tx.init(w)
+        # numpy sim
+        x, c = np.array([0.0]), np.array([0.0])
+        for _ in range(20):
+            g = x - target
+            u = -lr * g - alpha * (x - c)
+            x_new = x + u
+            c = c + beta * (x_new - c)
+            x = x_new
+        for _ in range(20):
+            g = jax.grad(lambda p: 0.5 * jnp.sum((p - target) ** 2))(w)
+            updates, state = tx.update(g, state, w)
+            w = optax.apply_updates(w, updates)
+        np.testing.assert_allclose(np.asarray(w), x, rtol=1e-5)
+
+    def test_distributed_easgd_matches_numpy_sim(self, world8):
+        # N workers with different local objectives (worker i pulls toward
+        # c_i), coupled through the elastic center — the reference's
+        # pserver/pclient dynamics as one SPMD step (SURVEY.md §4.2).
+        n = world8.num_devices
+        alpha, beta, lr = 0.1, 0.4, 0.2
+        rng = np.random.RandomState(3)
+        targets = rng.randn(n, 2).astype(np.float32) * 3
+
+        tx = optax.chain(
+            gopt.goo(lr), gopt.elastic_average(alpha, beta, axis="data")
+        )
+
+        def step(w, state, tgt):
+            g = w - tgt  # grad of 0.5||w - tgt||^2
+            updates, state = tx.update(g, state, w)
+            return optax.apply_updates(w, updates), state
+
+        w = jnp.zeros((n, 2))
+        # state structure: (GooState(momentum=()), ElasticState(center));
+        # the center is per-worker (varying along 'data').
+        state_spec = jax.tree.map(
+            lambda _: P("data"), jax.eval_shape(tx.init, jnp.zeros((1, 2)))
+        )
+        state = world8.shard_map(
+            tx.init, in_specs=P("data"), out_specs=state_spec
+        )(w)
+        stepper = world8.shard_map(
+            step,
+            in_specs=(P("data"), state_spec, P("data")),
+            out_specs=(P("data"), state_spec),
+        )
+
+        # numpy simulation of the same dynamics
+        x = np.zeros((n, 2), np.float32)
+        c = np.zeros((n, 2), np.float32)  # center replicated (same per worker)
+        tgts = targets
+        wj = w
+        for _ in range(15):
+            g = x - tgts
+            u = -lr * g - alpha * (x - c)
+            x_new = x + u
+            xbar = x_new.mean(0, keepdims=True)
+            c = c + beta * (np.broadcast_to(xbar, c.shape) - c)
+            x = x_new
+            wj, state = stepper(wj, state, jnp.asarray(targets))
+        np.testing.assert_allclose(np.asarray(wj), x, rtol=1e-4, atol=1e-5)
+
+
+class TestSharded:
+    """ZeRO-1: sharded goo == unsharded goo, with state truly sharded."""
+
+    def _params(self):
+        rng = np.random.RandomState(7)
+        return {
+            "w": jnp.asarray(rng.randn(5, 3).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(3).astype(np.float32)),
+        }
+
+    @pytest.mark.parametrize("make_tx", [
+        lambda: gopt.goo(0.1, 0.9),
+        lambda: gopt.goo_adam(1e-2),
+    ])
+    def test_matches_unsharded(self, world8, make_tx):
+        params = self._params()
+        tx = make_tx()
+        ref_state = tx.init(params)
+        state = gopt.sharded_init(world8, tx, params)
+
+        rng = np.random.RandomState(8)
+        p_ref, p_sh = params, params
+        for _ in range(5):
+            grads = jax.tree.map(
+                lambda p: jnp.asarray(rng.randn(*p.shape).astype(np.float32)), params
+            )
+            ref_updates, ref_state = tx.update(grads, ref_state, p_ref)
+            p_ref = optax.apply_updates(p_ref, ref_updates)
+            sh_updates, state = gopt.sharded_update(
+                world8, tx, grads, state, p_sh
+            )
+            p_sh = optax.apply_updates(p_sh, sh_updates)
+            tree_close(p_sh, p_ref, rtol=1e-5, atol=1e-6)
+
+    def test_state_is_sharded(self, world8):
+        params = self._params()  # 18 elements -> padded to 24, shard=3
+        tx = gopt.goo(0.1, 0.9)
+        state = gopt.sharded_init(world8, tx, params)
+        n = world8.num_devices
+        total = 5 * 3 + 3
+        padded = total + ((-total) % n)
+        # momentum buffer is one flat padded vector sharded over devices
+        assert state.momentum.shape == (padded,)
+        assert len(state.momentum.sharding.device_set) == n
+
+    def test_local_grads_reduce_scatter_path(self, world8):
+        # In-jit path: per-device local grads, summed via reduce-scatter.
+        n = world8.num_devices
+        params = {"w": jnp.zeros((4,), jnp.float32)}
+        tx = gopt.goo(1.0)
+        stx = gopt.sharded(tx, "data", mean_grads=False)
+
+        state = gopt.sharded_init(world8, tx, params)
+        local_grads = jnp.stack(
+            [jnp.full((4,), float(i + 1)) for i in range(n)]
+        )  # sum = n(n+1)/2
+
+        def body(g, s, p):
+            u, s = stx.update({"w": g[0]}, s, p)
+            return u, s
+
+        from mpit_tpu.opt.sharded import state_partition_specs
+
+        specs = state_partition_specs(tx, params, n, "data")
+        f = world8.shard_map(
+            body,
+            in_specs=(P("data"), specs, P()),
+            out_specs=(P(), specs),
+        )
+        updates, state = f(local_grads, state, params)
+        expect = -(n * (n + 1) / 2)
+        np.testing.assert_allclose(np.asarray(updates["w"]), np.full(4, expect))
