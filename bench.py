@@ -1,0 +1,85 @@
+"""Benchmark entry point — prints ONE JSON line for the driver.
+
+Headline metric (BASELINE.json): AlexNet ImageNet images/sec. Runs the real
+SPMD training step (fwd/bwd/goo update, ZeRO-1 sharded state) on synthetic
+ImageNet-shaped data on whatever devices are available (the driver runs this
+on real TPU hardware).
+
+``vs_baseline`` is reported as 1.0: the reference publishes no benchmark
+numbers (``BASELINE.json "published": {}``; see BASELINE.md), so there is no
+external denominator — the recorded value itself becomes the cross-round
+baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def bench_alexnet(batch_per_device: int = 64, steps: int = 20, warmup: int = 3):
+    import mpit_tpu
+    from mpit_tpu import opt as gopt
+    from mpit_tpu.data import shard_batch, synthetic_imagenet
+    from mpit_tpu.models import AlexNet
+    from mpit_tpu.train import make_train_step
+
+    world = mpit_tpu.init()
+    n = world.num_devices
+    global_batch = batch_per_device * n
+
+    model = AlexNet(num_classes=1000)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 224, 224, 3), jnp.float32)
+    )["params"]
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["image"])
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(
+            jnp.take_along_axis(logp, batch["label"][:, None], axis=1)
+        )
+        return loss, {}
+
+    tx = gopt.goo(0.01, 0.9)
+    init_fn, step_fn, _ = make_train_step(loss_fn, tx, world, zero1=True)
+    state = init_fn(params)
+
+    # Two pre-staged batches, alternated, so no step can be served from a
+    # cached/identical-input artifact; successive steps still chain through
+    # the state dependency, so the final block times the whole run.
+    ds = synthetic_imagenet()
+    stream = ds.batches(global_batch)
+    batches = [shard_batch(world, next(stream)) for _ in range(2)]
+
+    for i in range(warmup):
+        state, metrics = step_fn(state, batches[i % 2])
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step_fn(state, batches[i % 2])
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = global_batch * steps / dt
+    return {
+        "metric": "alexnet_imagenet_images_per_sec",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": 1.0,
+        "detail": {
+            "devices": n,
+            "platform": jax.devices()[0].platform,
+            "global_batch": global_batch,
+            "steps": steps,
+            "final_loss": round(float(metrics["loss"]), 4),
+        },
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_alexnet()))

@@ -33,6 +33,7 @@ from mpit_tpu.comm.collectives import (
     send_to,
     shift,
     size,
+    vary,
 )
 
 __all__ = [
@@ -54,4 +55,5 @@ __all__ = [
     "send_to",
     "shift",
     "size",
+    "vary",
 ]

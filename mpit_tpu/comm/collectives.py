@@ -36,10 +36,16 @@ from jax import lax
 # is *marked* replicated for shard_map's VMA checker (plain lax.all_gather
 # returns a varying-typed value). Public in spirit; lives in _src in jax 0.9.
 from jax._src.lax.parallel import all_gather_invariant as _all_gather_invariant
+from jax._src.lax.parallel import pvary as _pvary
 
 AxisName = str | Sequence[str]
 
 _REDUCE_OPS = ("sum", "mean", "max", "min", "prod")
+
+
+def axis_tuple(axis: AxisName) -> tuple[str, ...]:
+    """Normalize an axis name or sequence of names to a tuple."""
+    return (axis,) if isinstance(axis, str) else tuple(axis)
 
 
 def rank(axis: str):
@@ -60,6 +66,21 @@ def size(axis: AxisName):
     return out
 
 
+def vary(x, axis: AxisName):
+    """Mark a replicated pytree as device-varying along ``axis``.
+
+    Load-bearing for gradient semantics under jax 0.9's VMA-checked
+    shard_map: differentiating a *varying* loss with respect to
+    *replicated* params makes AD insert an automatic ``psum`` — the grads
+    arrive already cross-device summed, and any explicit pmean/
+    reduce-scatter then double-counts (observed as exactly N× updates).
+    Taking the grad w.r.t. a ``vary``-ed copy of the params keeps grads
+    local so the training step controls the one reduction itself.
+    """
+    names = axis_tuple(axis)
+    return jax.tree.map(lambda l: _pvary(l, names), x)
+
+
 def allreduce(x, axis: AxisName, *, op: str = "sum"):
     """All-reduce — the ``mpiT.Allreduce`` analogue (the sync-DP primitive).
 
@@ -78,7 +99,7 @@ def allreduce(x, axis: AxisName, *, op: str = "sum"):
     if op == "prod":
         # No native pprod collective: invariant-gather then reduce locally
         # (identical on every device, typed replicated).
-        names = (axis,) if isinstance(axis, str) else tuple(axis)
+        names = axis_tuple(axis)
         y = x
         for a in names:
             y = jnp.prod(_all_gather_invariant(y, a, axis=0), axis=0)

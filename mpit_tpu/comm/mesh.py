@@ -119,9 +119,7 @@ class World:
         """
         from mpit_tpu.comm import collectives as C
 
-        axes = self.axis_names if axis is None else (
-            (axis,) if isinstance(axis, str) else tuple(axis)
-        )
+        axes = self.axis_names if axis is None else C.axis_tuple(axis)
         f = self.shard_map(
             lambda v: C.allreduce(v, axes, op=op), in_specs=P(axes), out_specs=P()
         )

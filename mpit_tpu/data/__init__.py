@@ -1,0 +1,33 @@
+"""mpit_tpu.data — input pipelines.
+
+The reference borrows Torch7 dataset loaders (MNIST, ImageNet) in its
+training scripts (SURVEY.md §2 L2 — external dependency, not part of the
+repo proper). This build environment has no network egress (SURVEY.md §8.1),
+so the pipeline design is:
+
+- :mod:`mpit_tpu.data.synthetic` — deterministic, *learnable* synthetic
+  datasets shaped like the real workloads (MNIST 28×28×1, ImageNet
+  224×224×3, LM token streams). Learnable means labels are a function of
+  the inputs (class prototypes + noise; induced token grammar), so
+  loss-decrease and accuracy tests are meaningful.
+- :mod:`mpit_tpu.data.loader` — batching, host→device prefetch (double
+  buffered), and global-batch sharding over the mesh's data axis. Real
+  dataset loaders plug in behind the same iterator interface.
+"""
+
+from mpit_tpu.data.synthetic import (
+    SyntheticClassification,
+    SyntheticLM,
+    synthetic_imagenet,
+    synthetic_mnist,
+)
+from mpit_tpu.data.loader import Prefetcher, shard_batch
+
+__all__ = [
+    "SyntheticClassification",
+    "SyntheticLM",
+    "synthetic_mnist",
+    "synthetic_imagenet",
+    "Prefetcher",
+    "shard_batch",
+]

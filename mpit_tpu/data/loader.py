@@ -1,0 +1,105 @@
+"""Batch sharding and host→device prefetch.
+
+The reference's input pipeline is synchronous Torch dataset loading inside
+the training loop (SURVEY.md §4.2 "data load + preprocess"). TPU-natively,
+input must overlap with device compute or it becomes the bottleneck
+(HBM-fed cores starve on host IO):
+
+- :func:`shard_batch` lays a global host batch out across the mesh's data
+  axis (device i gets rows ``[i·B/N, (i+1)·B/N)``) as one sharded
+  ``jax.Array`` — the SPMD analogue of each worker rank loading its own
+  partition.
+- :class:`Prefetcher` pulls from a (possibly native C++-backed) iterator on
+  a background thread and keeps ``depth`` batches in flight on device, so
+  step N's compute overlaps step N+1's host work and transfer.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def shard_batch(world, batch, *, axis: str = "data"):
+    """Place a global host batch sharded along ``axis`` over the mesh.
+
+    Each array's leading dimension must be divisible by the axis size.
+    Returns a pytree of committed ``jax.Array``s (zero-copy per-device
+    slices where the platform allows).
+    """
+    sharding = NamedSharding(world.mesh, P(axis))
+
+    def put(x):
+        x = np.asarray(x)
+        if x.shape[0] % world.axis_size(axis):
+            raise ValueError(
+                f"batch dim {x.shape[0]} not divisible by {axis}={world.axis_size(axis)}"
+            )
+        return jax.device_put(x, sharding)
+
+    return jax.tree.map(put, batch)
+
+
+class Prefetcher:
+    """Background-thread prefetch of sharded device batches.
+
+    Wraps a host iterator; ``depth`` batches are materialized on device
+    ahead of consumption. Iteration order is preserved. Call
+    :meth:`close` (or exhaust) to join the thread; also usable as a
+    context manager.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, world, it: Iterator, *, axis: str = "data", depth: int = 2):
+        self._world = world
+        self._axis = axis
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._exc: BaseException | None = None
+
+        def worker():
+            try:
+                for batch in it:
+                    if self._stop.is_set():
+                        return
+                    self._queue.put(shard_batch(world, batch, axis=axis))
+            except BaseException as e:  # surfaced on next __next__
+                self._exc = e
+            finally:
+                self._queue.put(self._SENTINEL)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+    def close(self):
+        self._stop.set()
+        # Drain so the worker's blocked put() can observe the stop flag.
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

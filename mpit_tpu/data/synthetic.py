@@ -1,0 +1,114 @@
+"""Deterministic, learnable synthetic datasets (no-network environment).
+
+Stand-ins for the reference's workload datasets (MNIST for LeNet, ImageNet
+for AlexNet/ResNet-50; SURVEY.md §3.2 A4/A5) with the same shapes and a
+ground-truth structure a model can actually learn:
+
+- classification: each class has a fixed random prototype image; samples are
+  ``prototype + noise``. Bayes-optimal accuracy approaches 1.0 for modest
+  noise, so "reaches N% accuracy" tests are meaningful.
+- language modeling: tokens follow a sparse random bigram transition table
+  (an induced grammar); a transformer can push per-token cross-entropy well
+  below the uniform-distribution baseline.
+
+Everything is seeded and generated on the fly — no disk, no download.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SyntheticClassification:
+    """Prototype-plus-noise image classification stream."""
+
+    image_shape: tuple[int, ...] = (28, 28, 1)
+    num_classes: int = 10
+    noise: float = 0.3
+    seed: int = 0
+
+    def __post_init__(self):
+        rng = np.random.RandomState(self.seed)
+        self.prototypes = rng.randn(self.num_classes, *self.image_shape).astype(
+            np.float32
+        )
+
+    def batches(
+        self, batch_size: int, *, seed: int | None = None
+    ) -> Iterator[dict[str, np.ndarray]]:
+        """Infinite stream of ``{"image": [B,...], "label": [B]}``."""
+        rng = np.random.RandomState(self.seed + 1 if seed is None else seed)
+        while True:
+            labels = rng.randint(0, self.num_classes, size=(batch_size,))
+            images = self.prototypes[labels] + self.noise * rng.randn(
+                batch_size, *self.image_shape
+            ).astype(np.float32)
+            yield {"image": images.astype(np.float32), "label": labels.astype(np.int32)}
+
+    def eval_batch(self, batch_size: int, *, seed: int = 10_000):
+        return next(self.batches(batch_size, seed=seed))
+
+
+def synthetic_mnist(noise: float = 0.4, seed: int = 0) -> SyntheticClassification:
+    """MNIST-shaped stream: 28×28×1, 10 classes (baseline configs #1/#2)."""
+    return SyntheticClassification(
+        image_shape=(28, 28, 1), num_classes=10, noise=noise, seed=seed
+    )
+
+
+def synthetic_imagenet(
+    image_size: int = 224, num_classes: int = 1000, noise: float = 0.5, seed: int = 0
+) -> SyntheticClassification:
+    """ImageNet-shaped stream: 224×224×3, 1000 classes (configs #3/#4)."""
+    return SyntheticClassification(
+        image_shape=(image_size, image_size, 3),
+        num_classes=num_classes,
+        noise=noise,
+        seed=seed,
+    )
+
+
+@dataclasses.dataclass
+class SyntheticLM:
+    """Sparse-bigram language-model stream (GPT-2 stretch config).
+
+    Each token's successor is drawn from ``branching`` allowed successors
+    (fixed random table). Uniform baseline loss is ``log(vocab)``; a model
+    that learns the table reaches ``log(branching)`` — a large, testable
+    gap.
+    """
+
+    vocab_size: int = 1024
+    branching: int = 4
+    seed: int = 0
+
+    def __post_init__(self):
+        rng = np.random.RandomState(self.seed)
+        self.successors = rng.randint(
+            0, self.vocab_size, size=(self.vocab_size, self.branching)
+        ).astype(np.int32)
+
+    @property
+    def uniform_loss(self) -> float:
+        return float(np.log(self.vocab_size))
+
+    @property
+    def optimal_loss(self) -> float:
+        return float(np.log(self.branching))
+
+    def batches(
+        self, batch_size: int, seq_len: int, *, seed: int | None = None
+    ) -> Iterator[dict[str, np.ndarray]]:
+        """Infinite stream of ``{"tokens": [B, L+1]}`` (shift for targets)."""
+        rng = np.random.RandomState(self.seed + 1 if seed is None else seed)
+        while True:
+            toks = np.empty((batch_size, seq_len + 1), np.int32)
+            toks[:, 0] = rng.randint(0, self.vocab_size, size=batch_size)
+            for t in range(seq_len):
+                choice = rng.randint(0, self.branching, size=batch_size)
+                toks[:, t + 1] = self.successors[toks[:, t], choice]
+            yield {"tokens": toks}

@@ -1,0 +1,24 @@
+"""mpit_tpu.models — the workload model zoo.
+
+The reference defines its models inline in the Torch7 training scripts
+(LeNet-style convnet for MNIST, AlexNet for ImageNet; SURVEY.md §3.2
+A4/A5). Here they are first-class flax modules, plus the models the
+acceptance ladder adds beyond the reference (BASELINE.json configs #4/#5):
+
+- :class:`LeNet`     — MNIST convnet (config #1/#2).
+- :class:`AlexNet`   — ImageNet workhorse (config #3; north-star ≥58% top-1).
+- :class:`ResNet50`  — sync-DP + sharded-goo config (#4).
+- :class:`GPT2`      — transformer stretch config (#5), built on
+  :mod:`mpit_tpu.parallel` layers so TP/SP/CP shardings apply.
+
+All image models take NHWC float32/bfloat16 inputs; compute-heavy matmuls
+run in bfloat16 (MXU-native) with float32 params unless configured
+otherwise.
+"""
+
+from mpit_tpu.models.lenet import LeNet
+from mpit_tpu.models.alexnet import AlexNet
+from mpit_tpu.models.resnet import ResNet50
+from mpit_tpu.models.gpt2 import GPT2, GPT2Config
+
+__all__ = ["LeNet", "AlexNet", "ResNet50", "GPT2", "GPT2Config"]

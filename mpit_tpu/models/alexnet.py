@@ -1,0 +1,48 @@
+"""AlexNet — the ImageNet workload (baseline config #3; north-star model).
+
+The reference trains AlexNet via Torch7 ``nn`` in its ``asyncsgd/`` ImageNet
+scripts (SURVEY.md §3.2 A5); the north-star target is 58% top-1 on 32 TPU
+chips (BASELINE.json). Modern (torchvision-style) AlexNet shape: five convs
+with max-pools after 1/2/5, then 4096-4096-C fully connected.
+
+TPU notes: the FC layers are where the params are (MXU-friendly big
+matmuls); convs run NHWC which is XLA's preferred TPU layout. bfloat16
+compute by default — AlexNet trains fine in bf16 with f32 params.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.0  # classic 0.5; default off for deterministic steps
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        conv = lambda f, k, s, p: nn.Conv(
+            f, (k, k), strides=(s, s), padding=[(p, p), (p, p)], dtype=self.dtype
+        )
+        x = nn.relu(conv(64, 11, 4, 2)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(192, 5, 1, 2)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(384, 3, 1, 1)(x))
+        x = nn.relu(conv(256, 3, 1, 1)(x))
+        x = nn.relu(conv(256, 3, 1, 1)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

@@ -1,0 +1,142 @@
+"""GPT-2 — the transformer stretch workload (baseline config #5).
+
+Not in the reference (Torch7-era, pre-transformer; SURVEY.md §3.3); enters
+via the acceptance ladder ("GPT-2 small — stretch", BASELINE.json). Pre-LN
+GPT-2 architecture: learned positional embeddings, causal self-attention,
+GELU MLP, weight-tied LM head.
+
+Built TPU-first and parallelism-aware:
+
+- module names (``qkv``/``proj``/``fc``/``out``) are the stable hooks the
+  tensor-parallel sharding rules in :mod:`mpit_tpu.parallel` match on
+  (Megatron pattern: column-shard qkv/fc, row-shard proj/out);
+- the attention inner function is pluggable (``attention_fn``) so context
+  parallelism (ring attention) and Pallas flash kernels substitute without
+  touching the module tree;
+- bfloat16 activations/matmuls (MXU-native), float32 params, logits and
+  layernorms in float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+AttentionFn = Callable[..., jax.Array]  # (q, k, v, *, causal) -> out
+
+
+def default_attention(q, k, v, *, causal: bool = True):
+    """Plain causal attention: softmax(QKᵀ/√d)V, f32 softmax accumulators.
+
+    Shapes: [B, T, H, Dh] throughout (sequence-major, head-split), the
+    layout ring attention and Ulysses expect.
+    """
+    dh = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(dh)
+    if causal:
+        t_q, t_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int | None = None  # default 4*d_model
+    dtype: Any = jnp.bfloat16
+    attention_fn: AttentionFn = default_attention
+    remat: bool = False  # jax.checkpoint each block (HBM for FLOPs)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff or 4 * self.d_model
+
+    @staticmethod
+    def small(**kw) -> "GPT2Config":
+        """GPT-2 small (124M)."""
+        return GPT2Config(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "GPT2Config":
+        """Test-sized config for CI and fake-mesh runs."""
+        defaults = dict(
+            vocab_size=512, max_seq_len=128, num_layers=2, num_heads=4, d_model=64
+        )
+        defaults.update(kw)
+        return GPT2Config(**defaults)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(*t.shape[:-1], cfg.num_heads, cfg.head_dim)
+        attn = cfg.attention_fn(split(q), split(k), split(v), causal=True)
+        attn = attn.reshape(*attn.shape[:-2], cfg.d_model)
+        x = x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="proj")(attn)
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(cfg.ff_dim, dtype=cfg.dtype, name="fc")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="out")(h)
+        return x
+
+
+class GPT2(nn.Module):
+    cfg: GPT2Config = GPT2Config()
+
+    @nn.compact
+    def __call__(self, tokens):
+        """tokens [B, T] int32 → logits [B, T, vocab] float32."""
+        cfg = self.cfg
+        wte = self.param(
+            "wte",
+            nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.d_model),
+            jnp.float32,
+        )
+        wpe = self.param(
+            "wpe",
+            nn.initializers.normal(0.01),
+            (cfg.max_seq_len, cfg.d_model),
+            jnp.float32,
+        )
+        t = tokens.shape[-1]
+        x = wte[tokens].astype(cfg.dtype) + wpe[:t].astype(cfg.dtype)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block)
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # weight-tied LM head
+        logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32), wte)
+        return logits
+
+    @staticmethod
+    def loss_fn(logits, tokens):
+        """Next-token cross entropy: logits [B,T,V] vs tokens [B,T+1]."""
+        targets = tokens[:, 1:]
+        logits = logits[:, : targets.shape[1]]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)

@@ -1,0 +1,39 @@
+"""LeNet — the MNIST workload (baseline configs #1 and #2).
+
+The reference trains a LeNet-style convnet defined with Torch7 ``nn`` in its
+``asyncsgd/`` MNIST scripts (SURVEY.md §3.2 A4). This is the classic
+LeNet-5 shape (two conv+pool stages, two hidden FC layers) in flax.
+
+TPU notes: 28×28 convs are tiny for the MXU; the point of this model is the
+end-to-end slice (SURVEY.md §8.3) and distributed-semantics tests, not
+FLOPs. ``dtype`` lets the hot path run bfloat16 while params stay float32.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(120, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(84, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

@@ -1,0 +1,75 @@
+"""ResNet-50 — the sync-allreduce + sharded-goo workload (config #4).
+
+Not in the reference (which stops at AlexNet); enters via the acceptance
+ladder ("ImageNet ResNet-50 (sync allreduce path, sharded goo optimizer)",
+BASELINE.json). Standard bottleneck-v1.5 architecture (stride on the 3×3).
+
+TPU notes: NHWC layout; BatchNorm statistics are per-device by default —
+the train step syncs them with a ``pmean`` when cross-replica BN is enabled
+(the sync-DP semantics of config #4 concern gradients; BN sync is optional
+as in most data-parallel trainers). bfloat16 compute, float32 params and
+BN stats.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+    norm: Any = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        norm = partial(self.norm, use_running_average=False, dtype=jnp.float32)
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            padding=[(1, 1), (1, 1)], use_bias=False, dtype=self.dtype,
+        )(y)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)  # zero-init last BN scale
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                use_bias=False, dtype=self.dtype,
+            )(residual)
+            residual = norm()(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet50(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=self.dtype,
+        )(x)
+        x = nn.relu(
+            nn.BatchNorm(use_running_average=False, dtype=jnp.float32)(x)
+        )
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = Bottleneck(
+                    64 * 2**stage, strides=strides, dtype=self.dtype
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

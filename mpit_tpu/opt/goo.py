@@ -64,7 +64,15 @@ def goo(
 
     Returns an optax ``GradientTransformation`` producing *updates*
     (``−lr·g``) to be applied with ``optax.apply_updates``.
+
+    Rejects the configurations Torch rejects (nesterov without momentum or
+    with dampening) so parity can't silently diverge.
     """
+    if nesterov and (momentum == 0.0 or dampening != 0.0):
+        raise ValueError(
+            "nesterov requires momentum > 0 and dampening == 0 "
+            "(matching torch.optim.SGD's guard)"
+        )
 
     def init(params):
         if momentum == 0.0:

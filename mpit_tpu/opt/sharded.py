@@ -52,6 +52,15 @@ def sharded(
 ) -> optax.GradientTransformation:
     """Wrap ``tx`` so its state lives sharded along mesh ``axis``.
 
+    PRECONDITION: ``tx`` must be **elementwise** — its update for element i
+    may depend only on grad/param/state element i (true of the goo family:
+    SGD/momentum/Nesterov/Adam/AdamW, and of elastic_average). A
+    transformation using *global* statistics (``optax.clip_by_global_norm``,
+    adafactor's row/column factors, …) would compute them over each
+    device's 1/N shard and silently produce inconsistently-scaled update
+    blocks. Wrap such transforms OUTSIDE the sharded step, or compute their
+    statistics with explicit collectives first.
+
     Both ``init`` and ``update`` must be called inside ``shard_map`` over
     ``axis``:
 

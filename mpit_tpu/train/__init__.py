@@ -1,0 +1,30 @@
+"""mpit_tpu.train — the SPMD training step and loop.
+
+This layer is where the reference's two-actor protocol dies (BASELINE.json
+north-star): ``pserver.lua``'s blocking message loop + ``pclient.lua``'s
+Isend/Irecv push/pull (SURVEY.md §4.2) collapse into ONE jitted SPMD step —
+forward/backward, gradient combine (psum, or reduce-scatter under ZeRO-1),
+goo update, apply — compiled over the mesh, with input batches sharded
+along the data axis and optimizer state sharded across chips.
+
+- :mod:`mpit_tpu.train.step` — ``TrainState`` + :func:`make_train_step`.
+- :mod:`mpit_tpu.train.loop` — :class:`Trainer`: steps, metrics,
+  checkpointing, eval.
+- :mod:`mpit_tpu.train.checkpoint` — orbax-backed sharded checkpoints.
+- :mod:`mpit_tpu.train.metrics` — step metrics, throughput meters, JSONL.
+"""
+
+from mpit_tpu.train.step import TrainState, make_eval_step, make_train_step
+from mpit_tpu.train.loop import Trainer
+from mpit_tpu.train.checkpoint import CheckpointManager
+from mpit_tpu.train.metrics import MetricLogger, Throughput
+
+__all__ = [
+    "TrainState",
+    "make_train_step",
+    "make_eval_step",
+    "Trainer",
+    "CheckpointManager",
+    "MetricLogger",
+    "Throughput",
+]

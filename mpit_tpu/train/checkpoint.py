@@ -1,0 +1,83 @@
+"""Sharded checkpoint/resume (orbax-backed).
+
+The reference has no checkpoint format — at most ``torch.save`` of the
+model in a training script; the PS protocol state (goo state on the server)
+is lost on failure (SURVEY.md §6). Here checkpointing is first-class and
+sharding-aware: params, the *sharded* goo state, step counter and extra
+state are saved asynchronously and restored onto the same (or a compatible)
+mesh layout — restore rebuilds each array with the sharding derived from
+the trainer's PartitionSpecs.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+from jax.sharding import NamedSharding
+
+
+class CheckpointManager:
+    """Thin wrapper over ``orbax.checkpoint.CheckpointManager``.
+
+    ``specs`` (a pytree of PartitionSpecs matching the state, e.g. from
+    ``make_train_step``'s ``state_specs``) + the world's mesh determine how
+    arrays are laid out on restore.
+    """
+
+    def __init__(
+        self,
+        directory: str | Path,
+        world,
+        *,
+        max_to_keep: int = 3,
+        async_save: bool = True,
+    ):
+        self._world = world
+        self._mgr = ocp.CheckpointManager(
+            Path(directory).absolute(),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, enable_async_checkpointing=async_save
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def restore(self, state_like: Any, specs: Any, *, step: int | None = None):
+        """Restore the checkpoint at ``step`` (default: latest).
+
+        ``state_like`` supplies shapes/dtypes (concrete or abstract arrays);
+        ``specs`` the PartitionSpecs to lay shards out with.
+        """
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint found")
+        mesh = self._world.mesh
+        abstract = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=NamedSharding(mesh, s)
+            ),
+            state_like,
+            specs,
+        )
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        """Block until pending async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait()
+        self.close()

@@ -1,0 +1,103 @@
+"""The training loop: steps, metrics, checkpoints, eval.
+
+The reference's loop is the per-worker ``for each minibatch`` in its
+``asyncsgd/`` scripts plus the server's message loop (SURVEY.md §4.2); here
+a single :class:`Trainer` drives the jitted SPMD step over a prefetched
+sharded data stream.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import jax
+
+from mpit_tpu.data.loader import Prefetcher
+from mpit_tpu.train.metrics import MetricLogger, Throughput
+from mpit_tpu.train.step import TrainState
+
+
+class Trainer:
+    """Drive ``step_fn`` over a data stream with logging and checkpoints.
+
+    Args:
+      world: communication World.
+      state: initial TrainState (from ``make_train_step``'s init_fn, or a
+        checkpoint restore).
+      step_fn: jitted ``(state, batch) -> (state, metrics)``.
+      batches: host-side batch iterator (numpy pytrees); sharded and
+        prefetched internally.
+      items_per_batch: global batch size, for the items/sec meter.
+      log_every: metric log interval (steps).
+      logger: MetricLogger (default: stdout only).
+      checkpoint: optional (CheckpointManager, save_every) pair.
+      hooks: callables ``hook(step, state, metrics)`` run at log points.
+    """
+
+    def __init__(
+        self,
+        world,
+        state: TrainState,
+        step_fn: Callable,
+        batches: Iterator,
+        *,
+        items_per_batch: int | None = None,
+        log_every: int = 50,
+        logger: MetricLogger | None = None,
+        checkpoint: tuple[Any, int] | None = None,
+        hooks: list[Callable] | None = None,
+        axis: str = "data",
+    ):
+        self.world = world
+        self.state = state
+        self._step_fn = step_fn
+        self._batches = batches
+        self._items = items_per_batch
+        self._log_every = log_every
+        self._logger = logger or MetricLogger()
+        self._ckpt = checkpoint
+        self._hooks = hooks or []
+        self._axis = axis
+        self._throughput = Throughput()
+
+    @property
+    def step(self) -> int:
+        return int(self.state.step)
+
+    def train(self, num_steps: int) -> dict[str, float]:
+        """Run ``num_steps`` steps; returns the last logged metrics."""
+        last: dict[str, float] = {}
+        with Prefetcher(self.world, self._batches, axis=self._axis) as stream:
+            for _ in range(num_steps):
+                batch = next(stream)
+                self.state, metrics = self._step_fn(self.state, batch)
+                step = int(self.state.step)
+                if step % self._log_every == 0 or step == 1:
+                    # device sync happens here (float() blocks on the step)
+                    last = {k: float(v) for k, v in metrics.items()}
+                    if self._items is not None:
+                        rate = self._throughput.tick(
+                            self._items * self._log_every
+                        )
+                        if rate is not None:
+                            last["items_per_sec"] = rate
+                    self._logger.log(step, last)
+                    for hook in self._hooks:
+                        hook(step, self.state, last)
+                if self._ckpt is not None:
+                    mgr, every = self._ckpt
+                    if step % every == 0:
+                        mgr.save(step, self.state)
+        return last
+
+    def evaluate(
+        self, eval_step: Callable, batches: Iterator, num_batches: int
+    ) -> dict[str, float]:
+        """Average ``eval_step`` metrics over ``num_batches``."""
+        totals: dict[str, float] = {}
+        with Prefetcher(self.world, batches, axis=self._axis) as stream:
+            for _ in range(num_batches):
+                metrics = eval_step(self.state, next(stream))
+                for k, v in metrics.items():
+                    totals[k] = totals.get(k, 0.0) + float(v)
+        return {k: v / num_batches for k, v in totals.items()}

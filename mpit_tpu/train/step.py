@@ -1,0 +1,205 @@
+"""The SPMD training step — the collapsed pserver/pclient protocol.
+
+Reference hot loop (SURVEY.md §4.2): each worker computes fwd/bwd, Isends
+its gradient to the server, Irecvs fresh params; the server Recvs from
+ANY_SOURCE, applies goo, Sends params back. TPU-native (BASELINE.json
+north-star): one jitted function per step over the whole mesh —
+
+    grads = ∇loss(params, local_batch)
+    combine: pmean(grads, 'data')            (plain sync DP), or
+             reduce-scatter into shards      (ZeRO-1 sharded goo)
+    updates, opt_state = goo.update(...)
+    params ← params + updates                (all-gather under ZeRO-1)
+
+No messages, no tags, no server rank: the parameter server is now a
+collective + sharded state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mpit_tpu import opt as gopt
+from mpit_tpu.comm import collectives as C
+from mpit_tpu.opt.sharded import state_partition_specs
+
+
+class TrainState(NamedTuple):
+    """Replicated params + (optionally sharded) goo state + step counter.
+
+    ``extra`` carries non-gradient model state (e.g. BatchNorm batch_stats),
+    replicated.
+    """
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    extra: Any = ()
+
+
+def make_train_step(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    world,
+    *,
+    axis: str = "data",
+    zero1: bool = True,
+    stateful: bool = False,
+    donate: bool = True,
+):
+    """Build ``(init_fn, step_fn, state_specs)`` for SPMD data-parallel
+    training over ``world``'s ``axis``.
+
+    Args:
+      loss_fn: ``loss_fn(params, batch) -> (loss, aux)`` — or, when
+        ``stateful=True``, ``loss_fn(params, extra, batch) -> (loss, aux,
+        new_extra)`` (for models with BatchNorm-style mutable state; the
+        new extra is pmean-synced across replicas).
+      tx: the goo transformation (any optax transform).
+      world: the communication World.
+      axis: mesh data axis name.
+      zero1: shard optimizer state across ``axis`` (reduce-scatter/
+        all-gather path); False = replicated state + plain pmean DP.
+      donate: donate the input state buffers to the step (in-place update).
+
+    Returns:
+      ``init_fn(params, extra=()) -> TrainState`` (host-level),
+      ``step_fn(state, sharded_batch) -> (state, metrics)`` (jitted),
+      ``state_specs(params, extra=()) -> TrainState`` of PartitionSpecs.
+    """
+    n = world.axis_size(axis)
+    stx = gopt.sharded(tx, axis) if zero1 else None
+
+    def state_specs(params, extra=()):
+        if zero1:
+            opt_specs = state_partition_specs(tx, params, n, axis)
+        else:
+            opt_specs = jax.tree.map(
+                lambda _: P(), jax.eval_shape(tx.init, params)
+            )
+        return TrainState(
+            step=P(),
+            params=jax.tree.map(lambda _: P(), params),
+            opt_state=opt_specs,
+            extra=jax.tree.map(lambda _: P(), extra),
+        )
+
+    def _per_device_init(params, extra):
+        opt_state = stx.init(params) if zero1 else tx.init(params)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            extra=extra,
+        )
+
+    def init_fn(params, extra=()) -> TrainState:
+        specs = state_specs(params, extra)
+        f = world.shard_map(
+            _per_device_init, in_specs=(P(), specs.extra), out_specs=specs
+        )
+        return jax.jit(f)(params, extra)
+
+    def _per_device_step(state: TrainState, batch):
+        # Grads must be taken w.r.t. a device-varying view of the params:
+        # otherwise jax's VMA-aware AD auto-inserts a psum (grads arrive
+        # pre-summed) and the explicit reduction below would double-count.
+        # See comm.collectives.vary.
+        local_params = C.vary(state.params, axis)
+        if stateful:
+            def lf(p):
+                loss, aux, new_extra = loss_fn(p, state.extra, batch)
+                return loss, (aux, new_extra)
+
+            (loss, (aux, new_extra)), grads = jax.value_and_grad(
+                lf, has_aux=True
+            )(local_params)
+            new_extra = jax.tree.map(lambda e: lax.pmean(e, axis), new_extra)
+        else:
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                local_params, batch
+            )
+            new_extra = state.extra
+
+        if zero1:
+            # local grads in; reduce-scatter + shard-update + all-gather
+            # inside (mean semantics — stx was built with mean_grads=True).
+            updates, opt_state = stx.update(grads, state.opt_state, state.params)
+        else:
+            grads = jax.tree.map(lambda g: lax.pmean(g, axis), grads)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        metrics = {"loss": loss, **aux}
+        metrics = jax.tree.map(lambda m: lax.pmean(m, axis), metrics)
+        new_state = TrainState(
+            step=state.step + 1, params=params, opt_state=opt_state, extra=new_extra
+        )
+        return new_state, metrics
+
+    def build_step(params, extra=()):
+        specs = state_specs(params, extra)
+        f = world.shard_map(
+            _per_device_step,
+            in_specs=(specs, P(axis)),
+            out_specs=(specs, P()),
+        )
+        return jax.jit(f, donate_argnums=(0,) if donate else ())
+
+    # step_fn lazily builds (and caches) the compiled step on first call,
+    # keyed by state/batch structure.
+    compiled: dict = {}
+
+    def step_fn(state: TrainState, batch):
+        key = (
+            jax.tree_util.tree_structure((state, batch)),
+            tuple(
+                (l.shape, str(l.dtype)) for l in jax.tree.leaves((state, batch))
+            ),
+        )
+        f = compiled.get(key)
+        if f is None:
+            f = build_step(state.params, state.extra)
+            compiled[key] = f
+        return f(state, batch)
+
+    return init_fn, step_fn, state_specs
+
+
+def make_eval_step(eval_fn: Callable, world, *, axis: str = "data"):
+    """Build a jitted SPMD eval step: ``eval_fn(params, batch) -> metrics``
+    (pytree of scalars), pmean-reduced across replicas."""
+
+    def _per_device(params, extra, batch):
+        metrics = eval_fn(params, extra, batch)
+        return jax.tree.map(lambda m: lax.pmean(m, axis), metrics)
+
+    compiled: dict = {}
+
+    def step(state: TrainState, batch):
+        key = (
+            jax.tree_util.tree_structure((state.params, state.extra, batch)),
+            tuple(
+                (l.shape, str(l.dtype))
+                for l in jax.tree.leaves((state.params, state.extra, batch))
+            ),
+        )
+        f = compiled.get(key)
+        if f is None:
+            f = jax.jit(
+                world.shard_map(
+                    _per_device,
+                    in_specs=(P(), P(), P(axis)),
+                    out_specs=P(),
+                )
+            )
+            compiled[key] = f
+        return f(state.params, state.extra, batch)
+
+    return step

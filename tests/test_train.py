@@ -1,0 +1,224 @@
+"""End-to-end slice tests (SURVEY.md §8.3) + train-layer units.
+
+The acceptance milestone: LeNet on synthetic MNIST, jitted SPMD step over
+the fake 8-device mesh, loss decreases, and the 8-device trajectory matches
+the 1-device trajectory (allreduce correctness) — baseline configs #1/#2
+re-expressed TPU-natively.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mpit_tpu import comm
+from mpit_tpu import opt as gopt
+from mpit_tpu.data import Prefetcher, shard_batch, synthetic_mnist
+from mpit_tpu.models import LeNet
+from mpit_tpu.train import Trainer, make_eval_step, make_train_step
+
+
+def softmax_xent(logits, labels):
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def lenet_loss(model):
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["image"])
+        loss = softmax_xent(logits, batch["label"])
+        acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
+        return loss, {"acc": acc}
+
+    return loss_fn
+
+
+def init_lenet(seed=0):
+    model = LeNet()
+    params = model.init(jax.random.key(seed), jnp.zeros((1, 28, 28, 1)))["params"]
+    return model, params
+
+
+class TestData:
+    def test_synthetic_stream_deterministic(self):
+        ds = synthetic_mnist(seed=3)
+        a = next(ds.batches(8))
+        b = next(synthetic_mnist(seed=3).batches(8))
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+        assert a["image"].shape == (8, 28, 28, 1)
+
+    def test_shard_batch_layout(self, world8):
+        n = world8.num_devices
+        batch = {"x": np.arange(n * 2 * 3, dtype=np.float32).reshape(n * 2, 3)}
+        sharded = shard_batch(world8, batch)
+        assert len(sharded["x"].sharding.device_set) == n
+        np.testing.assert_array_equal(np.asarray(sharded["x"]), batch["x"])
+
+    def test_shard_batch_indivisible_raises(self, world8):
+        with pytest.raises(ValueError, match="not divisible"):
+            shard_batch(world8, {"x": np.zeros((3, 2))})
+
+    def test_prefetcher_order_and_close(self, world8):
+        def gen():
+            for i in range(10):
+                yield {"x": np.full((8, 1), float(i), np.float32)}
+
+        with Prefetcher(world8, gen(), depth=3) as pf:
+            vals = [float(np.asarray(b["x"])[0, 0]) for b in pf]
+        assert vals == [float(i) for i in range(10)]
+
+    def test_prefetcher_propagates_exception(self, world8):
+        def gen():
+            yield {"x": np.zeros((8, 1), np.float32)}
+            raise RuntimeError("boom")
+
+        pf = Prefetcher(world8, gen())
+        next(pf)
+        with pytest.raises(RuntimeError, match="boom"):
+            next(pf)
+
+
+class TestE2ESlice:
+    """Baseline config #1/#2: MNIST LeNet on 1 and 8 'workers'."""
+
+    @pytest.mark.parametrize("zero1", [False, True])
+    def test_loss_decreases_8dev(self, world8, zero1):
+        model, params = init_lenet()
+        tx = gopt.goo(0.05, 0.9)
+        init_fn, step_fn, _ = make_train_step(
+            lenet_loss(model), tx, world8, zero1=zero1
+        )
+        state = init_fn(params)
+        ds = synthetic_mnist(noise=0.3)
+        stream = ds.batches(32)
+        first = last = None
+        for _ in range(30):
+            batch = shard_batch(world8, next(stream))
+            state, metrics = step_fn(state, batch)
+            if first is None:
+                first = float(metrics["loss"])
+            last = float(metrics["loss"])
+        assert last < first * 0.5, (first, last)
+        assert int(state.step) == 30
+
+    def test_8dev_trajectory_matches_1dev(self, world8):
+        # Allreduce correctness: same global batches, same math, different
+        # device counts (SURVEY.md §8.3).
+        model, params = init_lenet()
+        tx = gopt.goo(0.05, 0.9)
+        world1 = comm.init(devices=[jax.devices()[0]], set_default=False)
+
+        losses = {}
+        for name, world in [("w1", world1), ("w8", world8)]:
+            init_fn, step_fn, _ = make_train_step(
+                lenet_loss(model), tx, world, zero1=False
+            )
+            state = init_fn(params)
+            stream = synthetic_mnist(noise=0.3).batches(32)
+            seq = []
+            for _ in range(10):
+                batch = shard_batch(world, next(stream))
+                state, metrics = step_fn(state, batch)
+                seq.append(float(metrics["loss"]))
+            losses[name] = seq
+        np.testing.assert_allclose(losses["w1"], losses["w8"], rtol=2e-3)
+
+    def test_zero1_trajectory_matches_replicated(self, world8):
+        model, params = init_lenet()
+        stream_a = synthetic_mnist(noise=0.3).batches(32)
+        stream_b = synthetic_mnist(noise=0.3).batches(32)
+        results = []
+        for zero1, stream in [(False, stream_a), (True, stream_b)]:
+            tx = gopt.goo(0.05, 0.9)
+            init_fn, step_fn, _ = make_train_step(
+                lenet_loss(model), tx, world8, zero1=zero1
+            )
+            state = init_fn(params)
+            seq = []
+            for _ in range(10):
+                batch = shard_batch(world8, next(stream))
+                state, m = step_fn(state, batch)
+                seq.append(float(m["loss"]))
+            results.append(seq)
+        np.testing.assert_allclose(results[0], results[1], rtol=2e-3)
+
+    def test_eval_step_accuracy(self, world8):
+        model, params = init_lenet()
+        tx = gopt.goo(0.05, 0.9)
+        init_fn, step_fn, _ = make_train_step(lenet_loss(model), tx, world8)
+        state = init_fn(params)
+        ds = synthetic_mnist(noise=0.2)
+        stream = ds.batches(64)
+        for _ in range(40):
+            state, _ = step_fn(state, shard_batch(world8, next(stream)))
+
+        def eval_fn(params, extra, batch):
+            logits = model.apply({"params": params}, batch["image"])
+            acc = jnp.mean(
+                (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32)
+            )
+            return {"acc": acc}
+
+        estep = make_eval_step(eval_fn, world8)
+        acc = float(
+            estep(state, shard_batch(world8, ds.eval_batch(64)))["acc"]
+        )
+        assert acc > 0.9, acc
+
+
+class TestTrainer:
+    def test_trainer_runs_and_logs(self, world8, tmp_path):
+        from mpit_tpu.train import MetricLogger
+
+        model, params = init_lenet()
+        tx = gopt.goo(0.05, 0.9)
+        init_fn, step_fn, _ = make_train_step(lenet_loss(model), tx, world8)
+        jsonl = tmp_path / "metrics.jsonl"
+        trainer = Trainer(
+            world8,
+            init_fn(params),
+            step_fn,
+            synthetic_mnist(noise=0.3).batches(32),
+            items_per_batch=32,
+            log_every=5,
+            logger=MetricLogger(jsonl, stdout=False),
+        )
+        last = trainer.train(15)
+        assert trainer.step == 15
+        assert "loss" in last
+        lines = jsonl.read_text().strip().splitlines()
+        assert len(lines) >= 3
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, world8, tmp_path):
+        from mpit_tpu.train import CheckpointManager
+
+        model, params = init_lenet()
+        tx = gopt.goo(0.05, 0.9)
+        init_fn, step_fn, state_specs = make_train_step(
+            lenet_loss(model), tx, world8, zero1=True
+        )
+        state = init_fn(params)
+        stream = synthetic_mnist().batches(32)
+        for _ in range(3):
+            state, _ = step_fn(state, shard_batch(world8, next(stream)))
+
+        with CheckpointManager(tmp_path / "ckpt", world8, async_save=False) as mgr:
+            mgr.save(3, state)
+            mgr.wait()
+            restored = mgr.restore(state, state_specs(params))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            jax.tree.leaves(state),
+            jax.tree.leaves(restored),
+        )
+        # restored state continues training (shardings compatible)
+        restored = jax.tree.map(jnp.asarray, restored)
+        state2, m = step_fn(
+            jax.tree.unflatten(jax.tree.structure(state), jax.tree.leaves(restored)),
+            shard_batch(world8, next(stream)),
+        )
+        assert int(state2.step) == 4
