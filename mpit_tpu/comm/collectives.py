@@ -36,7 +36,14 @@ from jax import lax
 # is *marked* replicated for shard_map's VMA checker (plain lax.all_gather
 # returns a varying-typed value). Public in spirit; lives in _src in jax 0.9.
 from jax._src.lax.parallel import all_gather_invariant as _all_gather_invariant
-from jax._src.lax.parallel import pvary as _pvary
+
+
+def _pvary(x, names):
+    # Replicated→varying retype: jax 0.9's public spelling is
+    # lax.pcast(..., to='varying'); fall back to the deprecated lax.pvary.
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, names, to="varying")
+    return lax.pvary(x, names)
 
 AxisName = str | Sequence[str]
 

@@ -1,0 +1,80 @@
+"""``mpit_tpu.compat`` — the ``mpiT``-flavored facade.
+
+Reference capability (SURVEY.md §3.1 C1/C3): the ``mpiT`` Lua module —
+``Init``/``Initialized``/``Finalize``, ``Comm_rank``/``Comm_size``/
+``Get_processor_name``, blocking ``Send``/``Recv``, nonblocking
+``Isend``/``Irecv`` with request objects and ``Wait``/``Test``/``Probe``,
+collectives ``Barrier``/``Bcast``/``Reduce``/``Allreduce``, and the datatype/
+communicator constants (``mpiT.DOUBLE``, ``mpiT.FLOAT``, ``mpiT.INT``,
+``mpiT.COMM_WORLD``, ``ANY_SOURCE``, ``ANY_TAG``).
+
+TPU-native position of this module (SURVEY.md §8.2.6, §8.4.1): tagged,
+receiver-driven async P2P has **no XLA/SPMD equivalent** — on the TPU the
+reference's two-actor protocol collapses into one synchronous jitted step
+(see ``mpit_tpu.train.step``). This facade therefore serves two distinct,
+honest purposes:
+
+1. **API parity + porting**: reference-shaped scripts (``pserver.lua`` /
+   ``pclient.lua`` style rank-role programs) run unchanged in semantics on a
+   host-level **multi-rank simulator** (:mod:`mpit_tpu.compat.simulator`):
+   each MPI rank is a Python thread, messages flow through tag-matched
+   mailboxes, collectives rendezvous at barriers. This is the in-tree
+   replacement for "``mpirun -n P`` on localhost *is* the fake cluster"
+   (SURVEY.md §5.1) — and it is what the ``asyncsgd`` parity actors and the
+   Downpour/EASGD dynamics tests run on.
+2. **Semantic documentation**: every entry point's docstring states what the
+   operation collapses to on the TPU path, so a reference user migrating a
+   script knows exactly where to land in ``mpit_tpu.comm``/``train``.
+
+Usage (the ``mpirun -n 4 th script.lua`` analogue)::
+
+    from mpit_tpu import compat as mpiT
+
+    def main():
+        mpiT.Init()
+        rank = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        size = mpiT.Comm_size(mpiT.COMM_WORLD)
+        ...
+        mpiT.Finalize()
+
+    mpiT.run(main, nranks=4)
+"""
+
+from mpit_tpu.compat.simulator import (  # noqa: F401
+    ANY_SOURCE,
+    AbortedError,
+    ANY_TAG,
+    BYTE,
+    CHAR,
+    COMM_WORLD,
+    DOUBLE,
+    FLOAT,
+    INT,
+    LONG,
+    MAX,
+    MIN,
+    PROD,
+    SUM,
+    Allreduce,
+    Barrier,
+    Bcast,
+    Comm,
+    Comm_rank,
+    Comm_size,
+    Finalize,
+    Get_processor_name,
+    Init,
+    Initialized,
+    Irecv,
+    Isend,
+    Probe,
+    Recv,
+    Reduce,
+    Request,
+    Send,
+    Status,
+    Test,
+    Wait,
+    Waitall,
+    run,
+)

@@ -1,0 +1,593 @@
+"""Host-level multi-rank MPI simulator backing the ``mpiT`` facade.
+
+Reference semantics reproduced here (SURVEY.md §3.1 C1, §4.2): tagged
+point-to-point with ``ANY_SOURCE``/``ANY_TAG`` wildcards, MPI's
+posted-receive matching order and non-overtaking rule; nonblocking
+``Isend``/``Irecv`` returning request objects polled via ``Wait``/``Test``;
+rendezvous collectives. Each MPI *process* becomes a Python *thread*;
+libmpi's transport becomes a condition-variable mailbox. This is
+deliberately a single-host simulation: it exists so that reference-shaped
+programs (the ``asyncsgd`` parameter-server actors, the reference's
+``mpirun -n 2..4`` smoke tests) run with their original semantics, and so
+the Downpour/EASGD dynamics can be parity-tested against the collapsed
+synchronous TPU path.
+
+On the TPU path none of this machinery runs: collectives are
+``mpit_tpu.comm.collectives`` inside ``jit``/``shard_map`` (XLA → ICI), and
+the async protocol is collapsed per BASELINE.json's north-star.
+
+Buffers are numpy arrays (the Torch-tensor analogue: mutable, host-resident).
+``Recv``-style calls write into the caller's buffer *and* return it; jax
+arrays are accepted on the send side (converted via ``np.asarray``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Constants — the mpiT.* constant surface (SURVEY.md §3.1 C1).
+# ---------------------------------------------------------------------------
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+
+# Datatype constants. The C binding needed these to pick MPI_Datatype for a
+# raw pointer; here numpy buffers carry their own dtype, so these exist only
+# so reference-shaped call sites (`mpiT.FLOAT` etc.) keep reading naturally.
+# Receives enforce sender/receiver dtype agreement instead (_check_transfer).
+DOUBLE = np.dtype(np.float64)
+FLOAT = np.dtype(np.float32)
+INT = np.dtype(np.int32)
+LONG = np.dtype(np.int64)
+CHAR = np.dtype(np.uint8)
+BYTE = np.dtype(np.uint8)
+
+# Reduce ops.
+SUM = "sum"
+MAX = "max"
+MIN = "min"
+PROD = "prod"
+
+_REDUCERS: dict[str, Callable[[list[np.ndarray]], np.ndarray]] = {
+    SUM: lambda xs: np.sum(xs, axis=0),
+    MAX: lambda xs: np.max(xs, axis=0),
+    MIN: lambda xs: np.min(xs, axis=0),
+    PROD: lambda xs: np.prod(xs, axis=0),
+}
+
+
+@dataclasses.dataclass
+class Status:
+    """The ``MPI_Status`` analogue: who sent the matched message, and what."""
+
+    source: int
+    tag: int
+    count: int
+
+
+def _check_transfer(buf: np.ndarray, data: np.ndarray) -> None:
+    """Receive-side contract: size and dtype must match exactly.
+
+    MPI would interpret raw bytes through the declared MPI_Datatype;
+    silently casting (e.g. float64 payload into an int32 buffer) would hide
+    porting bugs, so mismatches raise instead.
+    """
+    if data.size != buf.size:
+        raise ValueError(f"recv buffer size {buf.size} != message size {data.size}")
+    if data.dtype != buf.dtype:
+        raise TypeError(
+            f"recv buffer dtype {buf.dtype} != message dtype {data.dtype}"
+        )
+
+
+class _Message:
+    __slots__ = ("src", "tag", "data")
+
+    def __init__(self, src: int, tag: int, data: np.ndarray):
+        self.src = src
+        self.tag = tag
+        self.data = data
+
+
+def _matches(msg: _Message, src: int, tag: int) -> bool:
+    """The MPI envelope-matching rule, wildcards included."""
+    return (src == ANY_SOURCE or msg.src == src) and (
+        tag == ANY_TAG or msg.tag == tag
+    )
+
+
+class AbortedError(RuntimeError):
+    """Raised on ranks parked in Recv/Wait/Test/Probe when the job aborts
+    (another rank died) — the analogue of mpirun killing the job."""
+
+
+class Request:
+    """The ``MPI_Request`` analogue returned by ``Isend``/``Irecv``.
+
+    Isend requests complete immediately (buffered-send semantics — the
+    simulator's mailbox *is* the buffer, matching MPI's eager protocol for
+    the small messages the reference sends). Irecv requests are *posted* to
+    the destination mailbox at call time — matching happens in post order as
+    messages arrive (MPI's posted-receive queue), not at Wait/Test time.
+    """
+
+    def __init__(
+        self,
+        comm: "Comm",
+        kind: str,
+        buf: np.ndarray | None = None,
+        src: int = ANY_SOURCE,
+        tag: int = ANY_TAG,
+        rank: int | None = None,
+    ):
+        self._comm = comm
+        self._kind = kind
+        self._buf = buf
+        self._src = src
+        self._tag = tag
+        self._rank = rank
+        self._done = kind == "send"
+        self.status: Status | None = None
+
+    def _complete_locked(self, msg: _Message) -> None:
+        """Deliver ``msg`` into this request's buffer. Caller holds the
+        mailbox lock (may run on the *sender's* thread via ``put``)."""
+        assert self._buf is not None
+        flat = np.asarray(msg.data)
+        _check_transfer(self._buf, flat)
+        self._buf[...] = flat.reshape(self._buf.shape)
+        self.status = Status(source=msg.src, tag=msg.tag, count=flat.size)
+        self._done = True
+
+    def wait(self) -> Status | None:
+        """Block until complete — ``mpiT.Wait`` analogue."""
+        if not self._done:
+            assert self._rank is not None
+            self._comm._boxes[self._rank].wait_request(self)
+        return self.status
+
+    def test(self) -> bool:
+        """Nonblocking completion poll — ``mpiT.Test`` analogue."""
+        if self._done:
+            return True
+        assert self._rank is not None
+        return self._comm._boxes[self._rank].test_request(self)
+
+
+class _Mailbox:
+    """Per-rank transport state (the libmpi analogue): an unexpected-message
+    queue plus a posted-receive queue, both matched in arrival/post order —
+    which preserves MPI's non-overtaking rule per (src, tag) and its
+    posted-receive matching semantics (a message is routed to the *earliest
+    posted* matching receive at the moment it arrives, regardless of the
+    order Wait/Test are later called in).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: list[_Message] = []   # arrived, unmatched
+        self._posted: list[Request] = []     # posted Irecvs, unmatched
+        self._aborted = False
+
+    def _check_abort(self) -> None:
+        if self._aborted:
+            raise AbortedError("job aborted (a peer rank died)")
+
+    def put(self, msg: _Message) -> None:
+        """Deliver a message: earliest matching posted receive wins, else
+        queue as unexpected. May complete a request on the sender's thread."""
+        with self._cond:
+            for i, req in enumerate(self._posted):
+                if _matches(msg, req._src, req._tag):
+                    self._posted.pop(i)
+                    req._complete_locked(msg)
+                    self._cond.notify_all()
+                    return
+            self._pending.append(msg)
+            self._cond.notify_all()
+
+    def post(self, req: Request) -> None:
+        """Post a receive: match the earliest pending message now, else
+        queue on the posted-receive list."""
+        with self._cond:
+            self._check_abort()
+            for i, m in enumerate(self._pending):
+                if _matches(m, req._src, req._tag):
+                    self._pending.pop(i)
+                    req._complete_locked(m)
+                    return
+            self._posted.append(req)
+
+    def wait_request(self, req: Request) -> None:
+        with self._cond:
+            while not req._done:
+                self._check_abort()
+                self._cond.wait()
+
+    def test_request(self, req: Request) -> bool:
+        with self._cond:
+            if not req._done:
+                self._check_abort()
+            return req._done
+
+    def peek(self, src: int, tag: int, *, block: bool = True) -> _Message | None:
+        """Probe: wait for (or poll) a matching unexpected message without
+        consuming it."""
+        with self._cond:
+            while True:
+                self._check_abort()
+                for m in self._pending:
+                    if _matches(m, src, tag):
+                        return m
+                if not block:
+                    return None
+                self._cond.wait()
+
+    def abort(self) -> None:
+        with self._cond:
+            self._aborted = True
+            self._cond.notify_all()
+
+
+class Comm:
+    """A communicator: a fixed group of ranks — the ``MPI_Comm`` analogue.
+
+    Holds the mailboxes (P2P transport) and a two-phase rendezvous used by
+    all collectives. ``COMM_WORLD`` is resolved per-run to the communicator
+    created by :func:`run`.
+    """
+
+    def __init__(self, size: int, name: str = "world"):
+        self.size = size
+        self.name = name
+        self._boxes = [_Mailbox() for _ in range(size)]
+        self._barrier = threading.Barrier(size)
+        self._slots: list[Any] = [None] * size
+
+    # -- collective rendezvous ------------------------------------------------
+    def abort(self) -> None:
+        """Abort the job: break the barrier and wake all blocked receivers."""
+        self._barrier.abort()
+        for box in self._boxes:
+            box.abort()
+
+    def _exchange(self, rank: int, value: Any) -> list[Any]:
+        """Deposit ``value``, wait for all ranks, return everyone's deposits.
+
+        Deposits are **copied**: a rank may mutate its buffer the moment its
+        own collective call returns, while slower peers are still reading —
+        MPI's "buffer is yours again after return" contract requires the
+        snapshot. Two barrier phases: after the first, all deposits are
+        visible; the second guards the slots against being overwritten by a
+        subsequent collective before every rank has read them.
+        """
+        self._slots[rank] = (
+            np.array(value, copy=True) if isinstance(value, np.ndarray) else value
+        )
+        self._barrier.wait()
+        out = list(self._slots)
+        self._barrier.wait()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Per-thread rank context (what `mpirun` + MPI_Init gave each process).
+# ---------------------------------------------------------------------------
+
+_ctx = threading.local()
+
+
+def _require_ctx() -> tuple[int, Comm]:
+    rank = getattr(_ctx, "rank", None)
+    comm = getattr(_ctx, "comm", None)
+    if rank is None or comm is None:
+        # A bare script run outside `run()` is a world of one — exactly what
+        # running a reference script without mpirun gives.
+        comm = Comm(1, name="self")
+        _ctx.rank = rank = 0
+        _ctx.comm = comm
+        _ctx.initialized = False
+    return rank, comm
+
+
+def _resolve(comm: Comm | None) -> Comm:
+    if comm is None or comm is COMM_WORLD:
+        return _require_ctx()[1]
+    return comm
+
+
+class _WorldSentinel:
+    """``mpiT.COMM_WORLD``: resolves to the current run's world communicator."""
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return "COMM_WORLD"
+
+
+COMM_WORLD = _WorldSentinel()
+
+
+# ---------------------------------------------------------------------------
+# The mpiT API surface.
+# ---------------------------------------------------------------------------
+
+
+def Init() -> None:
+    """``mpiT.Init()``: join the world set up by the launcher.
+
+    TPU path analogue: ``mpit_tpu.comm.init()`` — reads device/pod topology
+    into a named mesh instead of reading ``mpirun`` rank/size (SURVEY.md
+    §4.1).
+    """
+    _require_ctx()
+    _ctx.initialized = True
+
+
+def Initialized() -> bool:
+    return bool(getattr(_ctx, "initialized", False))
+
+
+def Finalize() -> None:
+    """``mpiT.Finalize()``: leave the world (drains nothing; the simulator's
+    mailboxes die with the run)."""
+    _ctx.initialized = False
+
+
+def Comm_rank(comm: Comm | None = None) -> int:
+    """``mpiT.Comm_rank``. TPU path: ``comm.collectives.rank(axis)`` inside
+    jit (a per-device mesh coordinate), or ``jax.process_index()`` host-side."""
+    rank, c = _require_ctx()
+    if comm is None or comm is COMM_WORLD or c is comm:
+        return rank
+    raise ValueError("simulator supports rank queries on the world communicator")
+
+
+def Comm_size(comm: Comm | None = None) -> int:
+    """``mpiT.Comm_size``. TPU path: ``comm.collectives.size(axis)`` /
+    ``world.num_devices``."""
+    return _resolve(comm).size
+
+
+def Get_processor_name() -> str:
+    import platform
+
+    return platform.node() or "localhost"
+
+
+# -- point-to-point ----------------------------------------------------------
+
+
+def Send(buf, dest: int, tag: int = 0, comm: Comm | None = None) -> None:
+    """Blocking tagged send — ``mpiT.Send``.
+
+    TPU path: no tagged P2P exists under SPMD; static neighbor patterns map
+    to ``comm.collectives.permute/shift/send_to`` (compiled ``ppermute``),
+    and the parameter-server use collapses entirely (SURVEY.md §8.4.1).
+    """
+    rank, _ = _require_ctx()
+    c = _resolve(comm)
+    data = np.array(np.asarray(buf), copy=True)
+    c._boxes[dest].put(_Message(rank, tag, data))
+
+
+def Recv(
+    buf: np.ndarray,
+    src: int = ANY_SOURCE,
+    tag: int = ANY_TAG,
+    comm: Comm | None = None,
+) -> Status:
+    """Blocking tagged receive into ``buf`` — ``mpiT.Recv``. Returns Status
+    (where the reference surfaced source/tag via MPI_Status for the
+    ANY_SOURCE server loop, SURVEY.md §4.2).
+
+    Implemented as post-then-wait, so it takes its place in the
+    posted-receive queue *after* any outstanding Irecvs — MPI's matching
+    order.
+    """
+    req = Irecv(buf, src, tag, comm)
+    st = req.wait()
+    assert st is not None
+    return st
+
+
+def Isend(buf, dest: int, tag: int = 0, comm: Comm | None = None) -> Request:
+    """Nonblocking send — ``mpiT.Isend``. Completes immediately (buffered).
+
+    TPU path: XLA's async dispatch already overlaps collectives with
+    compute; explicit overlap is the Pallas tier (SURVEY.md §3.4).
+    """
+    Send(buf, dest, tag, comm)
+    return Request(_resolve(comm), "send")
+
+
+def Irecv(
+    buf: np.ndarray,
+    src: int = ANY_SOURCE,
+    tag: int = ANY_TAG,
+    comm: Comm | None = None,
+) -> Request:
+    """Nonblocking receive — ``mpiT.Irecv``; complete via Wait/Test.
+
+    The receive is *posted* now: an arriving message is routed to the
+    earliest posted matching receive, independent of Wait/Test order.
+    """
+    rank, _ = _require_ctx()
+    c = _resolve(comm)
+    req = Request(c, "recv", buf=buf, src=src, tag=tag, rank=rank)
+    c._boxes[rank].post(req)
+    return req
+
+
+def Wait(req: Request) -> Status | None:
+    """``mpiT.Wait``."""
+    return req.wait()
+
+
+def Waitall(reqs: Sequence[Request]) -> list[Status | None]:
+    return [r.wait() for r in reqs]
+
+
+def Test(req: Request) -> bool:
+    """``mpiT.Test``."""
+    return req.test()
+
+
+def Probe(
+    src: int = ANY_SOURCE, tag: int = ANY_TAG, comm: Comm | None = None
+) -> Status:
+    """Blocking probe — ``mpiT.Probe``: Status of the next matching message
+    without consuming it (the server loop's peek-then-dispatch tool)."""
+    rank, _ = _require_ctx()
+    c = _resolve(comm)
+    msg = c._boxes[rank].peek(src, tag, block=True)
+    assert msg is not None
+    return Status(source=msg.src, tag=msg.tag, count=msg.data.size)
+
+
+# -- collectives -------------------------------------------------------------
+
+
+def Barrier(comm: Comm | None = None) -> None:
+    """``mpiT.Barrier``. TPU path: ``comm.collectives.barrier(axis)`` (a
+    scheduling fence; SPMD lockstep makes most barriers implicit)."""
+    rank, _ = _require_ctx()
+    c = _resolve(comm)
+    c._exchange(rank, None)
+
+
+def Bcast(buf: np.ndarray, root: int = 0, comm: Comm | None = None) -> np.ndarray:
+    """``mpiT.Bcast``: root's buffer overwrites everyone's — the initial
+    parameter sync (SURVEY.md §4.4). TPU path:
+    ``comm.collectives.broadcast(x, axis, root=...)`` or simply replicated
+    init under SPMD."""
+    rank, _ = _require_ctx()
+    c = _resolve(comm)
+    vals = c._exchange(rank, np.asarray(buf) if rank == root else None)
+    if rank != root:
+        _check_transfer(buf, vals[root])
+        buf[...] = vals[root].reshape(buf.shape)
+    return buf
+
+
+def Reduce(
+    sendbuf,
+    recvbuf: np.ndarray | None = None,
+    op: str = SUM,
+    root: int = 0,
+    comm: Comm | None = None,
+) -> np.ndarray | None:
+    """``mpiT.Reduce``: reduced value lands at ``root`` only. TPU path:
+    ``comm.collectives.reduce`` (non-root devices hold zeros — a defined
+    contract, unlike MPI's undefined non-root buffer)."""
+    rank, _ = _require_ctx()
+    c = _resolve(comm)
+    vals = c._exchange(rank, np.asarray(sendbuf))
+    if rank != root:
+        return None
+    out = _REDUCERS[op]([np.asarray(v) for v in vals])
+    if recvbuf is not None:
+        _check_transfer(recvbuf, out)
+        recvbuf[...] = out.reshape(recvbuf.shape)
+        return recvbuf
+    return out
+
+
+def Allreduce(
+    sendbuf,
+    recvbuf: np.ndarray | None = None,
+    op: str = SUM,
+    comm: Comm | None = None,
+) -> np.ndarray:
+    """``mpiT.Allreduce`` — the sync-DP primitive (SURVEY.md §4.3).
+
+    TPU path: ``lax.psum`` via ``comm.collectives.allreduce`` inside the
+    jitted step — XLA lowers it to an ICI ring; the Pallas tier
+    (``comm.pallas_ring``) is the hand-scheduled equivalent.
+    """
+    rank, _ = _require_ctx()
+    c = _resolve(comm)
+    vals = c._exchange(rank, np.asarray(sendbuf))
+    out = _REDUCERS[op]([np.asarray(v) for v in vals])
+    if recvbuf is not None:
+        _check_transfer(recvbuf, out)
+        recvbuf[...] = out.reshape(recvbuf.shape)
+        return recvbuf
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Launcher — the `mpirun -n P` analogue.
+# ---------------------------------------------------------------------------
+
+
+def run(
+    fn: Callable[..., Any],
+    nranks: int,
+    *,
+    pass_rank: bool = False,
+    timeout: float | None = 120.0,
+) -> list[Any]:
+    """Run ``fn`` on ``nranks`` simulated ranks — the ``mpirun -n P`` analogue.
+
+    Each rank is a thread with its own rank context; ``fn`` is called with no
+    arguments (query :func:`Comm_rank` inside, reference-style) or with the
+    rank if ``pass_rank``. Returns each rank's return value, rank-ordered.
+    Exceptions on any rank abort the whole "job" (as a dead rank aborts an
+    ``mpirun`` job) and the root-cause error re-raises on the caller.
+    ``timeout`` bounds the *total* job wall-clock.
+    """
+    import time
+
+    world = Comm(nranks, name="world")
+    results: list[Any] = [None] * nranks
+    errors: list[BaseException | None] = [None] * nranks
+
+    def runner(r: int) -> None:
+        _ctx.rank = r
+        _ctx.comm = world
+        _ctx.initialized = False
+        try:
+            results[r] = fn(r) if pass_rank else fn()
+        except BaseException as e:  # noqa: BLE001 — surfaced to caller below
+            errors[r] = e
+            # Unblock peers stuck in a collective or a blocking receive: a
+            # dead MPI rank aborts the whole mpirun job.
+            world.abort()
+
+    threads = [
+        threading.Thread(
+            target=runner, args=(r,), name=f"mpit-rank-{r}", daemon=True
+        )
+        for r in range(nranks)
+    ]
+    for t in threads:
+        t.start()
+    deadline = None if timeout is None else time.monotonic() + timeout
+    timed_out = False
+    for t in threads:
+        t.join(
+            None if deadline is None else max(0.0, deadline - time.monotonic())
+        )
+        if t.is_alive():
+            timed_out = True
+            world.abort()
+
+    def _raise_first(pred) -> None:
+        for e in errors:
+            if e is not None and pred(e):
+                raise e
+
+    # The root-cause rank error, if any, beats the secondary wakeup errors
+    # (BrokenBarrierError / AbortedError on peers) and beats a timeout.
+    _raise_first(
+        lambda e: not isinstance(e, (threading.BrokenBarrierError, AbortedError))
+    )
+    if timed_out:
+        raise TimeoutError(f"rank thread(s) did not finish in {timeout}s")
+    _raise_first(lambda e: True)
+    return results

@@ -72,7 +72,12 @@ class Prefetcher:
             except BaseException as e:  # surfaced on next __next__
                 self._exc = e
             finally:
-                self._queue.put(self._SENTINEL)
+                # put_nowait: after close() drains, a blocked put may refill
+                # the queue; a blocking put here would deadlock the worker.
+                try:
+                    self._queue.put_nowait(self._SENTINEL)
+                except queue.Full:
+                    pass  # consumer is closing; sentinel unnecessary
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()

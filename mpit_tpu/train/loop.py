@@ -67,18 +67,23 @@ class Trainer:
     def train(self, num_steps: int) -> dict[str, float]:
         """Run ``num_steps`` steps; returns the last logged metrics."""
         last: dict[str, float] = {}
+        # Host-side step counter: reading state.step every iteration would
+        # block dispatch on the just-enqueued step and serialize host/device.
+        step = int(self.state.step)
+        tick_step = step
         with Prefetcher(self.world, self._batches, axis=self._axis) as stream:
             for _ in range(num_steps):
                 batch = next(stream)
                 self.state, metrics = self._step_fn(self.state, batch)
-                step = int(self.state.step)
+                step += 1
                 if step % self._log_every == 0 or step == 1:
                     # device sync happens here (float() blocks on the step)
                     last = {k: float(v) for k, v in metrics.items()}
                     if self._items is not None:
                         rate = self._throughput.tick(
-                            self._items * self._log_every
+                            self._items * (step - tick_step)
                         )
+                        tick_step = step
                         if rate is not None:
                             last["items_per_sec"] = rate
                     self._logger.log(step, last)

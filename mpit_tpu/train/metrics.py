@@ -14,6 +14,8 @@ import time
 from pathlib import Path
 from typing import Any, IO
 
+import numpy as np
+
 
 class Throughput:
     """Exponential-moving-average items/sec meter (excludes first interval,
@@ -65,7 +67,10 @@ class MetricLogger:
             try:
                 record[k] = float(v)
             except (TypeError, ValueError):
-                record[k] = v
+                try:  # non-scalar metric: JSON-serializable nested list
+                    record[k] = np.asarray(v).tolist()
+                except Exception:
+                    record[k] = str(v)
         if self._fh is not None:
             self._fh.write(json.dumps(record) + "\n")
         if self._stdout:

@@ -173,8 +173,8 @@ def make_train_step(
 
 
 def make_eval_step(eval_fn: Callable, world, *, axis: str = "data"):
-    """Build a jitted SPMD eval step: ``eval_fn(params, batch) -> metrics``
-    (pytree of scalars), pmean-reduced across replicas."""
+    """Build a jitted SPMD eval step: ``eval_fn(params, extra, batch) ->
+    metrics`` (pytree of scalars), pmean-reduced across replicas."""
 
     def _per_device(params, extra, batch):
         metrics = eval_fn(params, extra, batch)

@@ -1,0 +1,306 @@
+"""Tests for the mpiT compat facade (host-level multi-rank simulator).
+
+Mirrors the reference's own test strategy (SURVEY.md §5.1): small programs
+run under "mpirun -n 2..4" exercising tensor send/recv, async requests with
+Wait/Test, and collectives — here ``compat.run`` is the mpirun analogue.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mpit_tpu import compat as mpiT
+
+
+def test_world_of_one_without_run():
+    # A bare script outside run() is a world of one (no-mpirun behavior).
+    mpiT.Init()
+    assert mpiT.Initialized()
+    assert mpiT.Comm_size(mpiT.COMM_WORLD) == 1
+    assert mpiT.Comm_rank(mpiT.COMM_WORLD) == 0
+    mpiT.Finalize()
+    assert not mpiT.Initialized()
+
+
+def test_rank_size_under_run():
+    def main():
+        mpiT.Init()
+        return mpiT.Comm_rank(mpiT.COMM_WORLD), mpiT.Comm_size(mpiT.COMM_WORLD)
+
+    out = mpiT.run(main, 4)
+    assert out == [(r, 4) for r in range(4)]
+
+
+def test_blocking_send_recv_ring():
+    """Each rank sends its payload to (rank+1)%n — the ring smoke test."""
+    n = 4
+
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        payload = np.full((3,), float(r), np.float64)
+        buf = np.zeros((3,), np.float64)
+        if r % 2 == 0:  # stagger to avoid symmetric blocking assumptions
+            mpiT.Send(payload, dest=(r + 1) % n, tag=7)
+            mpiT.Recv(buf, src=(r - 1) % n, tag=7)
+        else:
+            mpiT.Recv(buf, src=(r - 1) % n, tag=7)
+            mpiT.Send(payload, dest=(r + 1) % n, tag=7)
+        return buf.copy()
+
+    out = mpiT.run(main, n)
+    for r in range(n):
+        np.testing.assert_array_equal(out[r], np.full((3,), float((r - 1) % n)))
+
+
+def test_isend_irecv_wait():
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        if r == 0:
+            req = mpiT.Isend(np.arange(5, dtype=np.float32), dest=1, tag=3)
+            mpiT.Wait(req)
+            return None
+        buf = np.zeros(5, np.float32)
+        req = mpiT.Irecv(buf, src=0, tag=3)
+        status = mpiT.Wait(req)
+        assert status.source == 0 and status.tag == 3 and status.count == 5
+        return buf
+
+    out = mpiT.run(main, 2)
+    np.testing.assert_array_equal(out[1], np.arange(5, dtype=np.float32))
+
+
+def test_test_polling():
+    import threading
+
+    release = threading.Event()
+
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        if r == 0:
+            release.wait(10)
+            mpiT.Send(np.ones(2), dest=1, tag=1)
+            return None
+        buf = np.zeros(2)
+        req = mpiT.Irecv(buf, src=0, tag=1)
+        assert not mpiT.Test(req)  # nothing sent yet
+        release.set()
+        while not mpiT.Test(req):
+            pass
+        return buf
+
+    out = mpiT.run(main, 2)
+    np.testing.assert_array_equal(out[1], np.ones(2))
+
+
+def test_any_source_server_loop():
+    """The pserver pattern (SURVEY.md §4.2): one server rank receives from
+    ANY_SOURCE, dispatches on tag, replies to status.source."""
+    n = 4
+    TAG_GRAD, TAG_REPLY = 1, 2
+
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        if r == 0:  # server: accumulate one grad from each client
+            acc = np.zeros(2)
+            for _ in range(n - 1):
+                buf = np.zeros(2)
+                st = mpiT.Recv(buf, src=mpiT.ANY_SOURCE, tag=TAG_GRAD)
+                acc += buf
+                mpiT.Send(acc.copy(), dest=st.source, tag=TAG_REPLY)
+            return acc
+        mpiT.Send(np.full(2, float(r)), dest=0, tag=TAG_GRAD)
+        buf = np.zeros(2)
+        mpiT.Recv(buf, src=0, tag=TAG_REPLY)
+        return buf
+
+    out = mpiT.run(main, n)
+    np.testing.assert_array_equal(out[0], np.full(2, 1.0 + 2.0 + 3.0))
+
+
+def test_tag_matching_fifo_and_wildcards():
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        if r == 0:
+            mpiT.Send(np.array([1.0]), dest=1, tag=10)
+            mpiT.Send(np.array([2.0]), dest=1, tag=20)
+            mpiT.Send(np.array([3.0]), dest=1, tag=10)
+            return None
+        buf = np.zeros(1)
+        mpiT.Recv(buf, src=0, tag=20)  # out-of-order tag match
+        a = buf[0]
+        st = mpiT.Probe(src=mpiT.ANY_SOURCE, tag=mpiT.ANY_TAG)
+        assert st.tag == 10
+        mpiT.Recv(buf, src=0, tag=10)  # FIFO within (src, tag)
+        b = buf[0]
+        mpiT.Recv(buf, src=mpiT.ANY_SOURCE, tag=mpiT.ANY_TAG)
+        c = buf[0]
+        return (a, b, c)
+
+    out = mpiT.run(main, 2)
+    assert out[1] == (2.0, 1.0, 3.0)
+
+
+def test_posted_receive_matching_order():
+    """MPI posted-receive semantics: a message is routed to the earliest
+    posted matching receive at arrival time, regardless of Wait order."""
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        if r == 0:
+            mpiT.Barrier()  # let rank 1 post both receives first
+            mpiT.Send(np.array([1.0]), dest=1, tag=1)
+            mpiT.Send(np.array([2.0]), dest=1, tag=2)
+            return None
+        buf_a = np.zeros(1)
+        buf_b = np.zeros(1)
+        req_a = mpiT.Irecv(buf_a, src=0, tag=1)
+        req_b = mpiT.Irecv(buf_b, src=0, tag=mpiT.ANY_TAG)
+        mpiT.Barrier()
+        mpiT.Wait(req_b)  # waiting on B first must not steal A's message
+        mpiT.Wait(req_a)
+        assert req_a.status.tag == 1 and req_b.status.tag == 2
+        return (buf_a[0], buf_b[0])
+
+    out = mpiT.run(main, 2)
+    assert out[1] == (1.0, 2.0)
+
+
+def test_bcast():
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        buf = np.full(4, float(r) if r == 2 else -1.0)
+        mpiT.Bcast(buf, root=2)
+        return buf
+
+    for row in mpiT.run(main, 4):
+        np.testing.assert_array_equal(row, np.full(4, 2.0))
+
+
+@pytest.mark.parametrize(
+    "op,expect", [(mpiT.SUM, 6.0), (mpiT.MAX, 3.0), (mpiT.MIN, 0.0), (mpiT.PROD, 0.0)]
+)
+def test_allreduce_ops(op, expect):
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        recv = np.zeros(2)
+        mpiT.Allreduce(np.full(2, float(r)), recv, op=op)
+        return recv
+
+    for row in mpiT.run(main, 4):
+        np.testing.assert_array_equal(row, np.full(2, expect))
+
+
+def test_reduce_root_only():
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        out = mpiT.Reduce(np.full(2, float(r)), op=mpiT.SUM, root=1)
+        return None if out is None else out.copy()
+
+    out = mpiT.run(main, 3)
+    assert out[0] is None and out[2] is None
+    np.testing.assert_array_equal(out[1], np.full(2, 3.0))
+
+
+def test_barrier_collective_reuse():
+    # Repeated collectives on the same communicator must not corrupt slots.
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        total = 0.0
+        for i in range(5):
+            mpiT.Barrier()
+            total += float(mpiT.Allreduce(np.array([float(r + i)]))[0])
+        return total
+
+    out = mpiT.run(main, 4)
+    # sum over ranks of (r+i) for i in 0..4 = (0+1+2+3) + 4*i each round
+    expect = sum(6.0 + 4.0 * i for i in range(5))
+    assert all(abs(v - expect) < 1e-9 for v in out)
+
+
+def test_rank_failure_propagates():
+    def main():
+        mpiT.Init()
+        if mpiT.Comm_rank(mpiT.COMM_WORLD) == 1:
+            raise RuntimeError("rank 1 died")
+        mpiT.Barrier()  # would hang forever without abort propagation
+
+    with pytest.raises(RuntimeError, match="rank 1 died"):
+        mpiT.run(main, 3, timeout=30)
+
+
+def test_rank_failure_wakes_blocked_recv():
+    """A dead rank must abort peers parked in a blocking Recv (not just in a
+    barrier) and surface the root-cause error, without waiting for timeout."""
+    import time
+
+    def main():
+        mpiT.Init()
+        if mpiT.Comm_rank(mpiT.COMM_WORLD) == 1:
+            raise RuntimeError("rank 1 died before sending")
+        buf = np.zeros(2)
+        mpiT.Recv(buf, src=1, tag=0)  # never satisfied
+
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="rank 1 died before sending"):
+        mpiT.run(main, 2, timeout=60)
+    assert time.monotonic() - t0 < 10  # aborted promptly, not via timeout
+
+
+def test_recv_dtype_mismatch_raises():
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        if r == 0:
+            mpiT.Send(np.ones(2, np.float64), dest=1, tag=0)
+            return
+        buf = np.zeros(2, np.int32)
+        mpiT.Recv(buf, src=0, tag=0)
+
+    with pytest.raises(TypeError, match="dtype"):
+        mpiT.run(main, 2, timeout=30)
+
+
+def test_collective_buffer_reuse_after_return():
+    """MPI contract: the send buffer is the caller's again once the call
+    returns — immediate mutation must not corrupt slower peers' results."""
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        ok = True
+        for i in range(50):
+            g = np.full(4, float(r + i))
+            out = mpiT.Allreduce(g)
+            g[...] = -1e9  # mutate immediately after return
+            ok &= bool(np.all(out == sum(float(q + i) for q in range(4))))
+        return ok
+
+    assert all(mpiT.run(main, 4))
+
+
+def test_allreduce_matches_tpu_collective(world8):
+    """Parity: the simulator's Allreduce equals the real device-collective
+    allreduce (comm.collectives via shard_map) on the same per-rank data."""
+    import jax.numpy as jnp
+
+    n = world8.num_devices
+    data = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+
+    device_result = np.asarray(world8.allreduce(jnp.asarray(data)))[0]
+
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        return mpiT.Allreduce(data[r])
+
+    sim_result = mpiT.run(main, n)[0]
+    np.testing.assert_allclose(sim_result, device_result, rtol=1e-6)
