@@ -474,6 +474,21 @@ def Bcast(buf: np.ndarray, root: int = 0, comm: Comm | None = None) -> np.ndarra
     return buf
 
 
+def _exchange_reduce(
+    sendbuf, recvbuf: np.ndarray | None, op: str, comm: Comm | None
+) -> np.ndarray:
+    """Shared tail of Reduce/Allreduce: exchange, reduce, copy out."""
+    rank, _ = _require_ctx()
+    c = _resolve(comm)
+    vals = c._exchange(rank, np.asarray(sendbuf))
+    out = _REDUCERS[op]([np.asarray(v) for v in vals])
+    if recvbuf is not None:
+        _check_transfer(recvbuf, out)
+        recvbuf[...] = out.reshape(recvbuf.shape)
+        return recvbuf
+    return out
+
+
 def Reduce(
     sendbuf,
     recvbuf: np.ndarray | None = None,
@@ -485,16 +500,12 @@ def Reduce(
     ``comm.collectives.reduce`` (non-root devices hold zeros — a defined
     contract, unlike MPI's undefined non-root buffer)."""
     rank, _ = _require_ctx()
-    c = _resolve(comm)
-    vals = c._exchange(rank, np.asarray(sendbuf))
+    # Every rank participates in the exchange; only root reduces/copies out.
     if rank != root:
+        c = _resolve(comm)
+        c._exchange(rank, np.asarray(sendbuf))
         return None
-    out = _REDUCERS[op]([np.asarray(v) for v in vals])
-    if recvbuf is not None:
-        _check_transfer(recvbuf, out)
-        recvbuf[...] = out.reshape(recvbuf.shape)
-        return recvbuf
-    return out
+    return _exchange_reduce(sendbuf, recvbuf, op, comm)
 
 
 def Allreduce(
@@ -509,15 +520,7 @@ def Allreduce(
     jitted step — XLA lowers it to an ICI ring; the Pallas tier
     (``comm.pallas_ring``) is the hand-scheduled equivalent.
     """
-    rank, _ = _require_ctx()
-    c = _resolve(comm)
-    vals = c._exchange(rank, np.asarray(sendbuf))
-    out = _REDUCERS[op]([np.asarray(v) for v in vals])
-    if recvbuf is not None:
-        _check_transfer(recvbuf, out)
-        recvbuf[...] = out.reshape(recvbuf.shape)
-        return recvbuf
-    return out
+    return _exchange_reduce(sendbuf, recvbuf, op, comm)
 
 
 # ---------------------------------------------------------------------------

@@ -72,12 +72,16 @@ class Prefetcher:
             except BaseException as e:  # surfaced on next __next__
                 self._exc = e
             finally:
-                # put_nowait: after close() drains, a blocked put may refill
-                # the queue; a blocking put here would deadlock the worker.
-                try:
-                    self._queue.put_nowait(self._SENTINEL)
-                except queue.Full:
-                    pass  # consumer is closing; sentinel unnecessary
+                # The sentinel MUST land (a consumer blocked in get() would
+                # otherwise hang forever), but a plain blocking put would
+                # deadlock against close() once it stops draining — so retry
+                # with a timeout, giving up only when close() has signalled.
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()

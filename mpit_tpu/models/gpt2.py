@@ -105,8 +105,14 @@ class GPT2(nn.Module):
     cfg: GPT2Config = GPT2Config()
 
     @nn.compact
-    def __call__(self, tokens):
-        """tokens [B, T] int32 → logits [B, T, vocab] float32."""
+    def __call__(self, tokens, positions=None):
+        """tokens [B, T] int32 → logits [B, T, vocab] float32.
+
+        ``positions`` ([T] or [B, T] int32) overrides the default
+        ``0..T-1`` — required under context parallelism, where each
+        device's T is a *slice* of the global sequence (pass
+        ``axis_index('seq') * T_local + arange(T_local)``).
+        """
         cfg = self.cfg
         wte = self.param(
             "wte",
@@ -121,7 +127,8 @@ class GPT2(nn.Module):
             jnp.float32,
         )
         t = tokens.shape[-1]
-        x = wte[tokens].astype(cfg.dtype) + wpe[:t].astype(cfg.dtype)
+        pe = wpe[:t] if positions is None else wpe[positions]
+        x = wte[tokens].astype(cfg.dtype) + pe.astype(cfg.dtype)
         block = Block
         if cfg.remat:
             block = nn.remat(Block)

@@ -1,0 +1,58 @@
+"""mpit_tpu.parallel — parallelism strategies beyond data parallelism.
+
+The reference implements only data parallelism (async parameter-server DP
+plus the collective primitives for sync DP; SURVEY.md §3.3). Everything in
+this package is *new capability* demanded by the acceptance ladder (GPT-2
+stretch config, BASELINE.json) and the task charter, built TPU-first:
+
+- :mod:`mpit_tpu.parallel.tp` — tensor parallelism as GSPMD sharding rules
+  (Megatron column/row pattern) consumed by a ``pjit`` train step; composes
+  with data parallelism and FSDP-style parameter sharding on a 2-D/3-D mesh.
+- :mod:`mpit_tpu.parallel.megatron` — the explicit ``shard_map`` tier of
+  tensor+sequence parallelism (column/row dense with hand-placed
+  psum / all-gather / reduce-scatter), for when collective placement must
+  be exact rather than GSPMD-inferred.
+- :mod:`mpit_tpu.parallel.pipeline` — GPipe-style pipeline parallelism over
+  a ``pipe`` mesh axis: microbatch ring via ``ppermute`` inside
+  ``lax.scan``, differentiable end-to-end.
+- :mod:`mpit_tpu.parallel.ring_attention` — context parallelism for long
+  sequences: blockwise causal attention with online-softmax accumulation
+  while K/V blocks rotate around the ``seq`` mesh axis ring.
+- :mod:`mpit_tpu.parallel.ulysses` — sequence parallelism for attention via
+  ``all_to_all``: sequence-sharded activations are re-sharded to
+  head-sharded for exact attention, then back.
+- :mod:`mpit_tpu.parallel.moe` — expert parallelism: top-k routed MoE MLP
+  with capacity-based dispatch and ``all_to_all`` token exchange over an
+  ``expert`` mesh axis.
+"""
+
+from mpit_tpu.parallel.ring_attention import ring_attention
+from mpit_tpu.parallel.ulysses import ulysses_attention
+from mpit_tpu.parallel.tp import (
+    gpt2_tp_rules,
+    fsdp_rules,
+    param_partition_specs,
+    make_pjit_train_step,
+)
+from mpit_tpu.parallel.pipeline import spmd_pipeline
+from mpit_tpu.parallel.megatron import (
+    column_parallel_dense,
+    row_parallel_dense,
+    tp_mlp,
+)
+from mpit_tpu.parallel.moe import MoEMLP, expert_parallel_moe
+
+__all__ = [
+    "ring_attention",
+    "ulysses_attention",
+    "gpt2_tp_rules",
+    "fsdp_rules",
+    "param_partition_specs",
+    "make_pjit_train_step",
+    "spmd_pipeline",
+    "column_parallel_dense",
+    "row_parallel_dense",
+    "tp_mlp",
+    "MoEMLP",
+    "expert_parallel_moe",
+]

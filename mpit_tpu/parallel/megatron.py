@@ -1,0 +1,87 @@
+"""Explicit-collective tensor + sequence parallelism (the shard_map tier).
+
+:mod:`mpit_tpu.parallel.tp` lets XLA's SPMD partitioner place the
+collectives; this module is the hand-placed Megatron-LM pattern
+(arXiv:1909.08053; SP refinement arXiv:2205.05198) for when the schedule
+must be exact — and as the executable specification the GSPMD tier is
+tested against.
+
+All functions run INSIDE ``shard_map`` over mesh axis ``axis`` and take the
+*local shard* of each weight (e.g. via ``in_specs=P(None, 'model')`` the
+column-parallel kernel arrives pre-sliced — no manual slicing):
+
+- :func:`column_parallel_dense` — kernel sharded on output features
+  [D, F/P]; output stays feature-sharded; no communication.
+- :func:`row_parallel_dense` — kernel sharded on input features [F/P, D];
+  finishes with one ``psum`` (sum of partial products).
+- :func:`tp_mlp` — the canonical pair: column(fc) → gelu → row(out), one
+  psum per MLP. With ``sequence_parallel=True`` the residual stream is
+  sequence-sharded outside the pair: the entry all-gather and the exit
+  reduce-scatter replace (and cost the same as) the psum, but activation
+  memory outside the matmuls drops by P.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpit_tpu.comm import collectives as C
+
+
+def column_parallel_dense(x, kernel, bias=None):
+    """y_local = x @ W_local (+ b_local): output feature-sharded, no comm.
+
+    x: [..., D] replicated (or sequence-sharded under SP after gather);
+    kernel: local [D, F/P]; bias: local [F/P] or None.
+    """
+    y = jnp.einsum("...d,df->...f", x, kernel)
+    return y if bias is None else y + bias
+
+
+def row_parallel_dense(x, kernel, bias=None, *, axis: str = "model", reduce: str = "psum"):
+    """y = psum_over_axis(x_local @ W_local) (+ b): the closing half.
+
+    x: [..., F/P] feature-sharded; kernel: local [F/P, D].
+    ``reduce='psum'`` returns the replicated sum; ``'scatter'`` returns a
+    sequence-sharded result via reduce-scatter on the sequence dim
+    (axis -2) — the Megatron-SP exit. Bias is full [D] (replicated) and is
+    added AFTER the reduction so it is counted once.
+    """
+    partial = jnp.einsum("...f,fd->...d", x, kernel)
+    if reduce == "psum":
+        y = lax.psum(partial, axis)
+    elif reduce == "scatter":
+        y = C.reduce_scatter(partial, axis, scatter_axis=partial.ndim - 2)
+    else:
+        raise ValueError(f"reduce must be 'psum' or 'scatter', got {reduce!r}")
+    return y if bias is None else y + bias
+
+
+def tp_mlp(
+    x,
+    fc_kernel,
+    fc_bias,
+    out_kernel,
+    out_bias,
+    *,
+    axis: str = "model",
+    sequence_parallel: bool = False,
+):
+    """The Megatron MLP block: column(fc) → gelu → row(out).
+
+    Plain TP: ``x`` [B, T, D] replicated in and out; one psum.
+    Megatron-SP: ``x`` [B, T/P, D] sequence-sharded in and out; the pair
+    becomes all-gather(seq) → column → gelu → row → reduce-scatter(seq).
+    """
+    if sequence_parallel:
+        x = C.allgather(x, axis, tiled=True, gather_axis=x.ndim - 2)
+    h = jax.nn.gelu(column_parallel_dense(x, fc_kernel, fc_bias))
+    return row_parallel_dense(
+        h,
+        out_kernel,
+        out_bias,
+        axis=axis,
+        reduce="scatter" if sequence_parallel else "psum",
+    )

@@ -1,0 +1,158 @@
+"""Expert parallelism: top-k routed Mixture-of-Experts with all-to-all.
+
+Absent from the reference (SURVEY.md §3.3 lists EP as new-framework-only).
+The GShard/Switch pattern (arXiv:2006.16668, arXiv:2101.03961) built
+TPU-first:
+
+- Routing and dispatch are dense one-hot einsums ([S,E,C] tensors) — no
+  gather/scatter with data-dependent shapes, so everything stays static for
+  XLA and lands on the MXU.
+- Capacity: each expert processes at most C = ceil(k·S·cf / E) tokens per
+  device; overflow tokens are dropped (their combine weight is zero, so
+  they pass through the residual connection untouched).
+- Expert parallelism: experts are sharded over mesh axis ``expert``
+  (contiguous blocks: device d owns experts [d·E/P, (d+1)·E/P)). One
+  ``all_to_all`` sends each expert's token slots to its owner; the inverse
+  ``all_to_all`` brings results home. Routing is local per device — no
+  global token shuffle, matching the standard EP formulation.
+- Load-balance aux loss (Switch §2.2): E · Σ_e f_e·P_e, pmean'd over the
+  axis so every device reports the global value.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpit_tpu.comm import collectives as C
+
+
+def top_k_dispatch(probs, k: int, capacity: int):
+    """Greedy top-k dispatch with per-expert capacity.
+
+    probs: [S, E] router probabilities (f32). Returns
+    ``(dispatch [S,E,C] 0/1, combine [S,E,C] f32)``; combine weights are the
+    selected gates renormalized to sum to 1 per token (pre-drop), the
+    standard top-2 convention.
+    """
+    s, e = probs.shape
+    dispatch = jnp.zeros((s, e, capacity), jnp.float32)
+    combine = jnp.zeros((s, e, capacity), jnp.float32)
+    masked = probs
+    taken = jnp.zeros((e,), jnp.int32)      # slots already used per expert
+    gate_sum = jnp.zeros((s,), jnp.float32)
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)                      # [S]
+        oh = jax.nn.one_hot(idx, e, dtype=jnp.int32)           # [S, E]
+        # Position of each token in its expert's queue: earlier tokens (and
+        # earlier rounds) first — deterministic, order-dependent like the
+        # reference implementations.
+        pos = taken[None, :] + jnp.cumsum(oh, axis=0) - oh     # [S, E]
+        taken = taken + jnp.sum(oh, axis=0)
+        gate = jnp.take_along_axis(probs, idx[:, None], axis=1)[:, 0]
+        gate_sum = gate_sum + gate
+        keep = (pos < capacity) & (oh > 0)                     # [S, E]
+        slot = jax.nn.one_hot(
+            jnp.clip(pos, 0, capacity - 1), capacity, dtype=jnp.float32
+        ) * keep[..., None].astype(jnp.float32)                # [S, E, C]
+        dispatch = dispatch + slot
+        combine = combine + slot * gate[:, None, None]
+        masked = jnp.where(oh > 0, -jnp.inf, masked)
+    combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+    return dispatch, combine
+
+
+def moe_capacity(tokens: int, num_experts: int, k: int, capacity_factor: float) -> int:
+    return max(1, math.ceil(k * tokens * capacity_factor / num_experts))
+
+
+def expert_parallel_moe(
+    x,
+    params: dict[str, Any],
+    *,
+    k: int = 2,
+    capacity_factor: float = 1.25,
+    axis: str | None = None,
+):
+    """Routed MoE MLP; with ``axis`` set, experts are sharded over that mesh
+    axis (call inside ``shard_map``; ``w_in``/``b_in``/``w_out``/``b_out``
+    arrive as local [E/P, ...] shards, router replicated).
+
+    params: ``router`` [D, E_global], ``w_in`` [E(,local), D, F], ``b_in``
+    [E, F], ``w_out`` [E, F, D], ``b_out`` [E, D].
+
+    Returns ``(out, aux_loss)`` with out shaped like x.
+    """
+    orig_shape = x.shape
+    d = x.shape[-1]
+    xf = x.reshape(-1, d)
+    s = xf.shape[0]
+    e_global = params["router"].shape[1]
+    capacity = moe_capacity(s, e_global, k, capacity_factor)
+
+    logits = (xf @ params["router"]).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine = top_k_dispatch(probs, k, capacity)
+
+    # [S,E,C] × [S,D] → per-expert token slots [E, C, D]
+    slots = jnp.einsum("sec,sd->ecd", dispatch, xf.astype(jnp.float32))
+    if axis is not None:
+        # Send each expert block to its owner; receive every device's slots
+        # for MY experts: [E, C, D] → [E/P, P·C, D] (P·C ordered by source).
+        slots = lax.all_to_all(slots, axis, split_axis=0, concat_axis=1, tiled=True)
+
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", slots, params["w_in"])
+        + params["b_in"][:, None, :]
+    )
+    y = (
+        jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+        + params["b_out"][:, None, :]
+    )
+    if axis is not None:
+        # Inverse exchange: my experts' outputs for device j's tokens go
+        # back to j; blocks re-assemble in global expert order.
+        y = lax.all_to_all(y, axis, split_axis=1, concat_axis=0, tiled=True)
+
+    out = jnp.einsum("sec,ecd->sd", combine, y)
+
+    # Switch load-balance loss on top-1 assignment fractions.
+    top1 = jax.nn.one_hot(jnp.argmax(probs, -1), e_global, dtype=jnp.float32)
+    f_e = jnp.mean(top1, axis=0)
+    p_e = jnp.mean(probs, axis=0)
+    aux = e_global * jnp.sum(f_e * p_e)
+    if axis is not None:
+        aux = lax.pmean(aux, axis)
+
+    return out.reshape(orig_shape).astype(x.dtype), aux
+
+
+class MoEMLP(nn.Module):
+    """Flax MoE MLP (dense single-device path; for EP extract ``params`` and
+    call :func:`expert_parallel_moe` with ``axis`` inside shard_map —
+    identical math, tested for parity in ``tests/test_parallel.py``)."""
+
+    num_experts: int
+    d_ff: int
+    k: int = 2
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        e, f = self.num_experts, self.d_ff
+        params = {
+            "router": self.param("router", nn.initializers.normal(0.02), (d, e)),
+            "w_in": self.param("w_in", nn.initializers.normal(0.02), (e, d, f)),
+            "b_in": self.param("b_in", nn.initializers.zeros, (e, f)),
+            "w_out": self.param("w_out", nn.initializers.normal(0.02), (e, f, d)),
+            "b_out": self.param("b_out", nn.initializers.zeros, (e, d)),
+        }
+        return expert_parallel_moe(
+            x, params, k=self.k, capacity_factor=self.capacity_factor, axis=None
+        )

@@ -1,0 +1,96 @@
+"""Pipeline parallelism: GPipe microbatch ring over a ``pipe`` mesh axis.
+
+Absent from the reference (SURVEY.md §3.3). TPU-native design: the P stages
+are the P devices along axis ``pipe``; activations move stage→stage with
+``lax.ppermute`` (one ICI neighbor hop) inside a single ``lax.scan`` of
+``M + P - 1`` ticks (M microbatches + P-1 bubble ticks). The whole schedule
+is one jitted SPMD program — no host round-trips between ticks — and is
+differentiable end-to-end: AD of ``ppermute`` is the reverse permute, so
+the backward pass is automatically the reverse pipeline with its own
+bubble.
+
+Layout: stage s's parameters live only on device s (in practice: stack the
+per-stage parameter trees on a leading [P, ...] axis and pass them through
+``shard_map`` with ``in_specs=P('pipe')``, so each device receives its
+[1, ...] slice). Every device sees the full [M, ...] microbatch array; only
+stage 0 reads it, only stage P-1's outputs are real, and the result is
+broadcast so it exits ``shard_map`` replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpit_tpu.comm import collectives as C
+
+
+def spmd_pipeline(
+    stage_fn: Callable,
+    stage_params,
+    microbatches,
+    *,
+    axis: str = "pipe",
+):
+    """Run ``microbatches`` through P pipeline stages; call inside shard_map.
+
+    Args:
+      stage_fn: ``stage_fn(stage_params, x) -> y`` — this device's stage.
+        Activation shape must be stage-invariant (y.shape == x.shape), the
+        usual transformer-block case; project in/out outside the pipeline.
+      stage_params: the LOCAL stage's params. If the leaves carry the
+        stacked leading axis (shard_map in_specs ``P('pipe')`` leaves a
+        leading dim of 1), it is squeezed automatically.
+      microbatches: [M, ...] — the batch pre-split into M microbatches,
+        replicated across the axis.
+
+    Returns [M, ...] outputs, replicated (broadcast from the last stage).
+    """
+    n = lax.axis_size(axis)
+    i = lax.axis_index(axis)
+    m = microbatches.shape[0]
+
+    def maybe_squeeze(leaf):
+        return leaf[0] if leaf.ndim >= 1 and leaf.shape[0] == 1 else leaf
+
+    params = jax.tree.map(maybe_squeeze, stage_params)
+
+    # Initial carry must be typed device-varying for shard_map's VMA checker
+    # (each stage's state/outputs genuinely differ per device).
+    state, outputs = C.vary(
+        (jnp.zeros_like(microbatches[0]), jnp.zeros_like(microbatches)), axis
+    )
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 ingests microbatch t (clamped during the drain bubble —
+        # those ticks' outputs never land anywhere); later stages consume
+        # what arrived from the previous stage last tick.
+        feed = microbatches[jnp.minimum(t, m - 1)]
+        x = jnp.where(i == 0, feed, state)
+        y = stage_fn(params, x)
+        # Last stage owns microbatch t-(P-1) once the pipe is full.
+        out_idx = jnp.clip(t - (n - 1), 0, m - 1)
+        landed = jnp.where(
+            (i == n - 1) & (t >= n - 1), y, outputs[out_idx]
+        )
+        outputs = lax.dynamic_update_index_in_dim(outputs, landed, out_idx, 0)
+        # One ring hop: stage i → i+1 (the wrap edge P-1 → 0 is ignored by
+        # stage 0, which reads from the feed).
+        state = C.shift(y, axis, offset=1)
+        return (state, outputs), None
+
+    (_, outputs), _ = lax.scan(
+        tick, (state, outputs), jnp.arange(m + n - 1)
+    )
+    # Only the last stage holds real outputs; replicate them.
+    return C.broadcast(outputs, axis, root=n - 1)
+
+
+def stack_stage_params(per_stage_params: list):
+    """Stack per-stage param trees on a new leading [P, ...] axis — the
+    layout :func:`spmd_pipeline` expects via in_specs ``P('pipe')``."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)

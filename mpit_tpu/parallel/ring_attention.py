@@ -1,0 +1,117 @@
+"""Context parallelism: ring attention over a ``seq`` mesh axis.
+
+Absent from the reference (Torch7-era, pre-transformer; SURVEY.md §3.3);
+required by the charter's long-context mandate. The design is the blockwise
+ring of Liu et al. (Ring Attention with Blockwise Transformers,
+arXiv:2310.01889), re-expressed with XLA collectives:
+
+- The sequence dimension is sharded over mesh axis ``seq``: each of the P
+  devices holds a [B, T/P, H, D] block of Q, K and V.
+- P ring steps: at step s, compute blockwise attention of the local Q
+  against the K/V block that originated on device ``(i - s) mod P``, fold
+  it into an online-softmax accumulator (running max / normalizer), then
+  rotate K/V one hop around the ring (``lax.ppermute`` — lowered to an ICI
+  neighbor exchange that XLA overlaps with the block's matmuls).
+- Memory per device is O(T/P) — sequence length scales linearly with the
+  ring size; no device ever materializes the full [T, T] score matrix.
+
+Causality needs *global* positions: device i's queries occupy global rows
+``i*T/P …``, and the K/V block at ring step s occupies global columns
+``src*T/P …``. Whole blocks that are entirely in the future still go
+through the accumulator (masked to -BIG) to keep the step count static for
+XLA; the online rescale zeroes their contribution exactly as soon as any
+real block dominates — and under causal self-attention every query row sees
+at least its own diagonal block, so no row is left fully masked.
+
+The XLA tier lives here; the fused Pallas flash kernel that replaces the
+per-block ``default_attention`` on real TPUs is
+:mod:`mpit_tpu.ops.flash_attention`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpit_tpu.comm import collectives as C
+
+# Finite "minus infinity": masked scores must stay finite so that a
+# fully-masked (future) block yields exp(0)=1 garbage that the online
+# rescale later multiplies by exp(-BIG)≈0, instead of NaN from inf-inf.
+_NEG_BIG = -2.0 ** 30
+
+
+def _block_attend(q, k, v, *, q_offset, k_offset, causal, scale):
+    """One blockwise attention contribution, in f32.
+
+    q: [B, Tq, H, D], k/v: [B, Tk, H, D]. Returns (o, l, m):
+    o [B, Tq, H, D] un-normalized, l [B, H, Tq] normalizer, m [B, H, Tq]
+    row max — the online-softmax triple for this block.
+    """
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        q_pos = q_offset + lax.iota(jnp.int32, t_q)
+        k_pos = k_offset + lax.iota(jnp.int32, t_k)
+        allowed = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(allowed, scores, _NEG_BIG)
+    m = jnp.max(scores, axis=-1)
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o, l, m
+
+
+def ring_attention(q, k, v, *, axis: str = "seq", causal: bool = True):
+    """Exact attention over a sequence sharded on mesh axis ``axis``.
+
+    Drop-in for ``mpit_tpu.models.gpt2.default_attention`` inside a
+    ``shard_map`` whose sequence dimension is sharded over ``axis``:
+    shapes [B, T_local, H, D] in, [B, T_local, H, D] out, numerically equal
+    to full attention on the gathered sequence (online softmax is exact).
+    """
+    p_size = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    t_local = q.shape[1]
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    q_offset = idx * t_local
+
+    b, tq, h, d = q.shape
+    # Accumulators start replicated-typed; mark them device-varying so the
+    # fori_loop carry type is stable under shard_map's VMA checker.
+    o, l, m = C.vary(
+        (
+            jnp.zeros((b, tq, h, d), jnp.float32),
+            jnp.zeros((b, h, tq), jnp.float32),
+            jnp.full((b, h, tq), _NEG_BIG, jnp.float32),
+        ),
+        axis,
+    )
+
+    def ring_step(s, carry):
+        o, l, m, k_blk, v_blk = carry
+        src = (idx - s) % p_size  # which device this K/V block came from
+        o_b, l_b, m_b = _block_attend(
+            q, k_blk, v_blk,
+            q_offset=q_offset, k_offset=src * t_local,
+            causal=causal, scale=scale,
+        )
+        m_new = jnp.maximum(m, m_b)
+        alpha = jnp.exp(m - m_new)       # rescale of the running accumulator
+        beta = jnp.exp(m_b - m_new)      # rescale of this block's contribution
+        l = l * alpha + l_b * beta
+        o = o * alpha.transpose(0, 2, 1)[..., None] + o_b * beta.transpose(0, 2, 1)[..., None]
+        # Rotate K/V one hop: device i's block moves to i+1 (ring).
+        perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+        k_blk = lax.ppermute(k_blk, axis, perm=perm)
+        v_blk = lax.ppermute(v_blk, axis, perm=perm)
+        return o, l, m_new, k_blk, v_blk
+
+    o, l, m, _, _ = lax.fori_loop(
+        0, p_size, ring_step, (o, l, m, k, v), unroll=True
+    )
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

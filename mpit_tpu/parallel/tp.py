@@ -1,0 +1,228 @@
+"""Tensor parallelism as GSPMD sharding rules + a ``pjit`` train step.
+
+Absent from the reference (SURVEY.md §3.3 — it is pure DP); enters for the
+GPT-2 stretch config. TPU-first design: rather than hand-writing the
+Megatron collectives, parameters are annotated with ``PartitionSpec``s
+(column-shard ``qkv``/``fc``, row-shard ``proj``/``out``) and the step is
+compiled with ``jax.jit`` over the whole mesh — XLA's SPMD partitioner
+infers the ``psum``/``all_gather``/``reduce_scatter`` placements and
+overlaps them with compute. The explicit-collective tier (when placement
+must be exact) is :mod:`mpit_tpu.parallel.megatron`.
+
+Composition on one mesh:
+- ``data`` axis: batch sharded → XLA inserts the gradient allreduce
+  (the reference's ``MPI_Allreduce`` role).
+- ``model`` axis: parameters sharded per the rules below → tensor
+  parallelism inside every matmul.
+- FSDP: ask :func:`param_partition_specs` for ``fsdp_axis`` and parameters
+  (plus optimizer state, which follows parameter specs) are additionally
+  sharded ZeRO-3-style; XLA all-gathers weights just-in-time per layer.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpit_tpu.train.step import TrainState
+
+Rules = Sequence[tuple[str, P]]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def gpt2_tp_rules(axis: str = "model") -> Rules:
+    """Megatron-pattern rules keyed to the GPT-2 module names
+    (``mpit_tpu.models.gpt2`` keeps ``qkv``/``proj``/``fc``/``out`` stable
+    precisely as hooks for these regexes).
+
+    Column-parallel (shard output features): qkv, fc — each device computes
+    a head/ff slice. Row-parallel (shard input features): proj, out — XLA
+    finishes with the psum. Embedding is vocab-sharded; layernorms and
+    positional embedding replicate.
+    """
+    return [
+        (r".*/qkv/kernel$", P(None, axis)),
+        (r".*/qkv/bias$", P(axis)),
+        (r".*/fc/kernel$", P(None, axis)),
+        (r".*/fc/bias$", P(axis)),
+        (r".*/proj/kernel$", P(axis, None)),
+        (r".*/out/kernel$", P(axis, None)),
+        (r".*wte$", P(axis, None)),
+    ]
+
+
+def fsdp_rules(axis: str = "fsdp") -> Rules:
+    """Pure-FSDP rules: shard every matrix's first dim; see also the
+    ``fsdp_axis`` argument of :func:`param_partition_specs`, which composes
+    FSDP *with* TP rules instead of replacing them."""
+    return [(r".*kernel$", P(axis)), (r".*wte$", P(axis))]
+
+
+def param_partition_specs(
+    params,
+    rules: Rules | None,
+    *,
+    fsdp_axis: str | None = None,
+    fsdp_size: int | None = None,
+):
+    """Match each parameter's tree path against ``rules`` (first hit wins;
+    no hit → replicated).
+
+    With ``fsdp_axis``: after rule matching, additionally shard the first
+    unassigned dimension divisible by ``fsdp_size`` — ZeRO-3-style
+    parameter sharding composed orthogonally with TP.
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in (rules or [])]
+
+    def spec_for(path, leaf):
+        name = _path_str(path)
+        spec = next((s for pat, s in compiled if pat.search(name)), P())
+        if fsdp_axis is None:
+            return spec
+        entries = list(spec) + [None] * (leaf.ndim - len(spec))
+        for d in range(leaf.ndim):
+            if entries[d] is None and leaf.shape[d] % (fsdp_size or 1) == 0 and leaf.shape[d] >= (fsdp_size or 1):
+                entries[d] = fsdp_axis
+                break
+        return P(*entries)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def specs_like_params(state_shapes, params, param_specs):
+    """Partition specs for an optimizer-state pytree: any state leaf whose
+    tree-path suffix and shape match a parameter (momentum/mu/nu trees
+    mirror the param tree) inherits that parameter's spec; everything else
+    (step counts, scalars) replicates."""
+    by_path: dict[tuple, Any] = {}
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_s = jax.tree_util.tree_flatten_with_path(param_specs)[0]
+    for (p_path, p_leaf), (_, spec) in zip(flat_p, flat_s):
+        by_path[tuple(_path_str((k,)) for k in p_path)] = (p_leaf.shape, spec)
+
+    def spec_for(path, leaf):
+        parts = tuple(_path_str((k,)) for k in path)
+        for p_parts, (shape, spec) in by_path.items():
+            if (
+                len(parts) >= len(p_parts)
+                and parts[-len(p_parts):] == p_parts
+                and tuple(leaf.shape) == tuple(shape)
+            ):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, state_shapes)
+
+
+def make_pjit_train_step(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    world,
+    rules: Rules | None = None,
+    *,
+    data_axis: str = "data",
+    fsdp_axis: str | None = None,
+    donate: bool = True,
+):
+    """Build ``(init_fn, step_fn, shardings_fn)`` for a GSPMD-partitioned
+    train step: DP over ``data_axis`` + TP per ``rules`` + optional FSDP.
+
+    The in-jit body is written as if single-device (no explicit
+    collectives); all parallelism comes from the in/out shardings. This is
+    the ``pjit`` counterpart of ``mpit_tpu.train.make_train_step`` (the
+    explicit ``shard_map`` tier) — same ``TrainState``, so checkpoints
+    interchange.
+    """
+    mesh = world.mesh
+    fsdp_size = world.axis_size(fsdp_axis) if fsdp_axis else None
+
+    def shardings_fn(params):
+        pspecs = param_partition_specs(
+            params, rules, fsdp_axis=fsdp_axis, fsdp_size=fsdp_size
+        )
+        opt_shapes = jax.eval_shape(tx.init, params)
+        ospecs = specs_like_params(opt_shapes, params, pspecs)
+        state_specs = TrainState(
+            step=P(), params=pspecs, opt_state=ospecs, extra=()
+        )
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            state_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def init_fn(params, extra=()) -> TrainState:
+        del extra  # pjit tier: stateless models (use make_train_step otherwise)
+        shardings = shardings_fn(params)
+        params = jax.device_put(params, shardings.params)
+
+        @jax.jit
+        def build(params):
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=tx.init(params),
+                extra=(),
+            )
+
+        return jax.jit(build, out_shardings=shardings)(params)
+
+    def _step(state: TrainState, batch):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, **aux}
+        return (
+            TrainState(
+                step=state.step + 1, params=params, opt_state=opt_state, extra=()
+            ),
+            metrics,
+        )
+
+    compiled: dict = {}
+
+    def step_fn(state: TrainState, batch):
+        key = (
+            jax.tree_util.tree_structure((state, batch)),
+            tuple(
+                (l.shape, str(l.dtype)) for l in jax.tree.leaves((state, batch))
+            ),
+        )
+        f = compiled.get(key)
+        if f is None:
+            shardings = shardings_fn(state.params)
+            # Pure-TP mesh (no data axis): batch replicates.
+            batch_spec = P(data_axis) if data_axis in mesh.axis_names else P()
+            batch_sh = jax.tree.map(
+                lambda _: NamedSharding(mesh, batch_spec), batch
+            )
+            f = jax.jit(
+                _step,
+                in_shardings=(shardings, batch_sh),
+                out_shardings=(shardings, NamedSharding(mesh, P())),
+                donate_argnums=(0,) if donate else (),
+            )
+            compiled[key] = f
+        return f(state, batch)
+
+    return init_fn, step_fn, shardings_fn

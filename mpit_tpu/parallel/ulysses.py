@@ -1,0 +1,60 @@
+"""Ulysses sequence parallelism: all-to-all head↔sequence re-sharding.
+
+Absent from the reference (SURVEY.md §3.3); the DeepSpeed-Ulysses pattern
+(arXiv:2309.14509) re-expressed as one ``lax.all_to_all`` pair over a mesh
+axis:
+
+- Activations arrive sequence-sharded: [B, T/P, H, D] per device.
+- ``all_to_all`` re-shards heads and gathers sequence → [B, T, H/P, D]:
+  each device now sees the FULL sequence for a subset of heads, so any
+  exact (or Pallas flash) attention runs unchanged — attention is
+  embarrassingly parallel over heads.
+- A second ``all_to_all`` restores sequence sharding for the rest of the
+  network.
+
+Trade-off vs ring attention (:mod:`mpit_tpu.parallel.ring_attention`): two
+dense all-to-alls of activation size vs P ppermute hops of K/V size; Ulysses
+needs ``H % P == 0`` and materializes full-T scores per head, ring keeps
+O(T/P) memory. Both are exact; pick per workload.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from jax import lax
+
+from mpit_tpu.models.gpt2 import default_attention
+
+
+def ulysses_attention(
+    q, k, v,
+    *,
+    axis: str = "seq",
+    causal: bool = True,
+    inner: Callable = default_attention,
+):
+    """Exact attention over a sequence sharded on mesh axis ``axis``.
+
+    Drop-in for ``default_attention`` inside a ``shard_map``: [B, T/P, H, D]
+    in and out. ``inner`` is the per-device attention on the re-sharded
+    [B, T, H/P, D] blocks — the seam where the Pallas flash kernel
+    (:mod:`mpit_tpu.ops.flash_attention`) slots in.
+    """
+    p_size = lax.axis_size(axis)
+    n_heads = q.shape[2]
+    if n_heads % p_size:
+        raise ValueError(
+            f"Ulysses needs heads ({n_heads}) divisible by axis size ({p_size}); "
+            "use ring_attention for head counts that don't divide"
+        )
+    # [B, T/P, H, D] -> [B, T, H/P, D]: split heads (axis 2), concat seq (axis 1)
+    to_heads = lambda x: lax.all_to_all(
+        x, axis, split_axis=2, concat_axis=1, tiled=True
+    )
+    # inverse: split seq, concat heads
+    to_seq = lambda x: lax.all_to_all(
+        x, axis, split_axis=1, concat_axis=2, tiled=True
+    )
+    o = inner(to_heads(q), to_heads(k), to_heads(v), causal=causal)
+    return to_seq(o)
