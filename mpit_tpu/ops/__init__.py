@@ -1,0 +1,21 @@
+"""mpit_tpu.ops — Pallas TPU kernels: the framework's native tier.
+
+Where the reference's native stratum is a C binding handing Torch tensor
+pointers to libmpi (SURVEY.md §2 L0), this framework's native stratum is
+hand-scheduled TPU kernels below the XLA tier:
+
+- :mod:`mpit_tpu.ops.ring_allreduce` — ring reduce-scatter + all-gather
+  over ICI via double-buffered ``make_async_remote_copy`` (the
+  ``MPI_Allreduce`` hot path, SURVEY.md §4.3; the "allreduce GB/s" metric).
+- :mod:`mpit_tpu.ops.flash_attention` — fused blockwise causal attention
+  (online softmax in VMEM; never materializes the [T, T] score matrix),
+  the per-block kernel under ring attention's outer loop.
+
+Every kernel has an ``interpret`` path (pltpu TPU interpret mode) so its
+semaphore/DMA discipline is testable on the CPU fake mesh (SURVEY.md §6
+"race detection" row), and an XLA-collective fallback for non-TPU backends.
+"""
+
+from mpit_tpu.ops.ring_allreduce import ring_allreduce
+
+__all__ = ["ring_allreduce"]

@@ -1,0 +1,182 @@
+"""Pallas ring allreduce — the native-tier ``MPI_Allreduce``.
+
+The reference's allreduce hot path is ``mpiT.Allreduce`` → ``MPI_Allreduce``
+→ libmpi's ring/tree (SURVEY.md §4.3). The XLA tier
+(``comm.collectives.allreduce`` = ``lax.psum``) already lowers to an ICI
+ring; this module is the hand-scheduled equivalent — the kernel the
+"allreduce GB/s" benchmark measures and the in-tree proof that the
+framework owns its communication stack down to the DMA level.
+
+Algorithm (classic two-phase ring, bandwidth-optimal 2·(P-1)/P · N):
+
+1. **Reduce-scatter** (P-1 steps): the payload is split into P chunks; at
+   step s every device sends its running sum of chunk ``(i-s) mod P`` one
+   hop clockwise through a double-buffered VMEM mailbox
+   (``make_async_remote_copy``) and adds the chunk arriving from its left
+   neighbor. After P-1 steps device i holds the fully-reduced chunk
+   ``(i+1) mod P``.
+2. **All-gather** (P-1 steps): the owned chunks circulate; each arriving
+   chunk is written straight into its slot of the output — no mailbox
+   needed, the output region IS the receive buffer.
+
+Synchronization discipline (the part interpret-mode tests pin down):
+- a neighbor barrier (``get_barrier_semaphore``) before the first send, so
+  no device writes into a mailbox that is not yet live;
+- per-slot DMA semaphores: ``rdma.wait()`` blocks on both the local send
+  completion and the remote delivery into THIS device;
+- alternating slots (s mod 2) so step s+1's incoming data can never
+  clobber the slot step s is still reading.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+_SUBLANE = 8  # float32 tile rows
+
+
+def _vary(x, axis):
+    # Scratch-buffer reads are VMA-replicated; retype to device-varying
+    # before mixing with the (varying) output ref.
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis,), to="varying")
+    return lax.pvary(x, (axis,))
+
+
+def _kernel(
+    x_ref, o_ref, comm_buf, send_sem, recv_sem, cap_sem, *, axis: str, num_devices: int
+):
+    p = num_devices
+    i = lax.axis_index(axis)
+    right = lax.rem(i + 1, p)
+    left = lax.rem(i - 1 + p, p)
+    rows = x_ref.shape[0] // p  # rows per chunk
+
+    o_ref[...] = x_ref[...]
+
+    if p == 1:
+        return
+
+    # Neighbor barrier: both neighbors must have entered the kernel (their
+    # mailboxes and output buffers are live) before any remote write.
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left})
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right})
+    pltpu.semaphore_wait(barrier, 2)
+
+    def chunk(ref, c):
+        return ref.at[pl.ds(c * rows, rows), :]
+
+    total = 2 * (p - 1)  # continuous step counter across both phases
+
+    def ship(g):
+        """Step g: stage in slot g%2; the write lands in the RECEIVER's slot
+        (g+1)%2 — distinct slots, so an early-arriving neighbor write never
+        collides with this device's own staging."""
+        # Back-pressure: before re-using a landing slot on the right
+        # neighbor (every slot is re-used from step 2 on), wait for its
+        # "slot free" signal — without this a fast sender can run 2+ steps
+        # ahead and clobber unconsumed data (caught by the interpret-mode
+        # tests; two slots alone are NOT a protocol).
+        if g >= 2:
+            pltpu.semaphore_wait(cap_sem.at[(g + 1) % 2], 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_buf.at[g % 2],
+            dst_ref=comm_buf.at[(g + 1) % 2],
+            send_sem=send_sem.at[g % 2],
+            recv_sem=recv_sem.at[(g + 1) % 2],
+            device_id={axis: right},
+        )
+        rdma.start()
+        rdma.wait()  # my send done AND left neighbor's chunk delivered
+
+    def consumed(g):
+        """Tell the LEFT neighbor its landing slot on me is free again."""
+        pltpu.semaphore_signal(
+            cap_sem.at[(g + 1) % 2], inc=1, device_id={axis: left}
+        )
+
+    # Python loops, not fori_loop: p is static, and the step index must stay
+    # a Python int so chunk indices are pure functions of the (device-
+    # varying) axis_index — the interpreter's VMA checker rejects mixing a
+    # replicated loop carry into varying address arithmetic.
+    # ---- phase 1: reduce-scatter -----------------------------------------
+    for s in range(p - 1):
+        send_c = lax.rem(i - s + p, p)
+        recv_c = lax.rem(i - s - 1 + 2 * p, p)
+        # Stage the running sum of send_c into the mailbox, ship it right.
+        comm_buf[s % 2] = o_ref[pl.ds(send_c * rows, rows), :]
+        ship(s)
+        o_ref[pl.ds(recv_c * rows, rows), :] += _vary(comm_buf[(s + 1) % 2], axis)
+        consumed(s)
+
+    # ---- phase 2: all-gather ---------------------------------------------
+    # Device i now owns reduced chunk (i+1) mod p; circulate ownership.
+    for s in range(p - 1):
+        g = (p - 1) + s  # continuous step counter across phases
+        send_c = lax.rem(i + 1 - s + 2 * p, p)
+        recv_c = lax.rem(i - s + 2 * p, p)
+        comm_buf[g % 2] = o_ref[pl.ds(send_c * rows, rows), :]
+        ship(g)
+        o_ref[pl.ds(recv_c * rows, rows), :] = _vary(comm_buf[(g + 1) % 2], axis)
+        consumed(g)
+
+    # Drain: the final two "slot free" signals have no matching send-side
+    # wait; absorb them so the semaphores return to zero for the next call.
+    pltpu.semaphore_wait(cap_sem.at[(total - 1) % 2], 1)
+    pltpu.semaphore_wait(cap_sem.at[total % 2], 1)
+
+
+def _ring_allreduce_2d(x2d, *, axis: str, interpret: bool):
+    p = lax.axis_size(axis)
+    kern = functools.partial(_kernel, axis=axis, num_devices=p)
+    rows = x2d.shape[0] // p
+    return pl.pallas_call(
+        kern,
+        # vma: the result is device-varying over the ring axis (shard_map
+        # VMA checker requires kernels to declare this explicitly).
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype, vma=frozenset({axis})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, _LANE), x2d.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),  # per-slot capacity tokens
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=0
+        ),
+        # TPU interpret mode (not the generic pallas interpreter): simulates
+        # remote DMAs + semaphores across shard_map "devices" on CPU.
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(x2d)
+
+
+def ring_allreduce(x, axis: str, *, interpret: bool = False):
+    """All-reduce-sum ``x`` over mesh axis ``axis`` — call inside shard_map.
+
+    Accepts any shape/f32-or-bf16 dtype; the payload is raveled, padded to
+    a [P · 8, 128] tile multiple, pushed through the Pallas ring, and
+    restored. ``interpret=True`` runs the TPU interpret mode (works on the
+    CPU fake mesh — the semaphore-discipline sanitizer of SURVEY.md §6).
+
+    Equivalent to ``lax.psum(x, axis)``; exists as the native tier and for
+    the GB/s benchmark.
+    """
+    p = lax.axis_size(axis)
+    flat = jnp.ravel(x)
+    n = flat.shape[0]
+    sublane = 16 if x.dtype == jnp.bfloat16 else _SUBLANE
+    pad = (-n) % (p * sublane * _LANE)
+    flat = jnp.pad(flat, (0, pad))
+    x2d = flat.reshape(-1, _LANE)
+    out = _ring_allreduce_2d(x2d, axis=axis, interpret=interpret)
+    return out.reshape(-1)[:n].reshape(x.shape)
