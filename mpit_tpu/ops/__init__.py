@@ -7,9 +7,6 @@ hand-scheduled TPU kernels below the XLA tier:
 - :mod:`mpit_tpu.ops.ring_allreduce` — ring reduce-scatter + all-gather
   over ICI via double-buffered ``make_async_remote_copy`` (the
   ``MPI_Allreduce`` hot path, SURVEY.md §4.3; the "allreduce GB/s" metric).
-- :mod:`mpit_tpu.ops.flash_attention` — fused blockwise causal attention
-  (online softmax in VMEM; never materializes the [T, T] score matrix),
-  the per-block kernel under ring attention's outer loop.
 
 Every kernel has an ``interpret`` path (pltpu TPU interpret mode) so its
 semaphore/DMA discipline is testable on the CPU fake mesh (SURVEY.md §6

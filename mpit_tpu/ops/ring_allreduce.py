@@ -16,16 +16,21 @@ Algorithm (classic two-phase ring, bandwidth-optimal 2·(P-1)/P · N):
    neighbor. After P-1 steps device i holds the fully-reduced chunk
    ``(i+1) mod P``.
 2. **All-gather** (P-1 steps): the owned chunks circulate; each arriving
-   chunk is written straight into its slot of the output — no mailbox
-   needed, the output region IS the receive buffer.
+   chunk is copied from the mailbox into its slot of the output.
 
-Synchronization discipline (the part interpret-mode tests pin down):
+Synchronization discipline (pinned down by tests/test_ops.py in TPU
+interpret mode):
 - a neighbor barrier (``get_barrier_semaphore``) before the first send, so
   no device writes into a mailbox that is not yet live;
-- per-slot DMA semaphores: ``rdma.wait()`` blocks on both the local send
-  completion and the remote delivery into THIS device;
-- alternating slots (s mod 2) so step s+1's incoming data can never
-  clobber the slot step s is still reading.
+- remote writes land ONLY in the double-buffered receive mailbox
+  (``recv_buf``); the send staging buffer (``send_buf``) is strictly
+  device-local, so an early neighbor can never clobber a send in flight;
+- ``rdma.wait()`` blocks on both the local send completion (making
+  ``send_buf`` safe to restage next step) and the remote delivery into
+  THIS device's ``recv_buf[g % 2]``;
+- capacity tokens: a landing slot is reused every 2 steps, and the reuse
+  at step g is gated on the receiver's "read done" token from step g-2 —
+  signaled only AFTER the receiver consumed the slot into its output.
 """
 
 from __future__ import annotations
@@ -38,20 +43,23 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mpit_tpu.comm.collectives import _pvary
+
 _LANE = 128
 _SUBLANE = 8  # float32 tile rows
 
 
-def _vary(x, axis):
-    # Scratch-buffer reads are VMA-replicated; retype to device-varying
-    # before mixing with the (varying) output ref.
-    if hasattr(lax, "pcast"):
-        return lax.pcast(x, (axis,), to="varying")
-    return lax.pvary(x, (axis,))
-
-
 def _kernel(
-    x_ref, o_ref, comm_buf, send_sem, recv_sem, cap_sem, *, axis: str, num_devices: int
+    x_ref,
+    o_ref,
+    send_buf,
+    recv_buf,
+    send_sem,
+    recv_sem,
+    cap_sem,
+    *,
+    axis: str,
+    num_devices: int,
 ):
     p = num_devices
     i = lax.axis_index(axis)
@@ -71,37 +79,40 @@ def _kernel(
     pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right})
     pltpu.semaphore_wait(barrier, 2)
 
-    def chunk(ref, c):
-        return ref.at[pl.ds(c * rows, rows), :]
-
     total = 2 * (p - 1)  # continuous step counter across both phases
 
-    def ship(g):
-        """Step g: stage in slot g%2; the write lands in the RECEIVER's slot
-        (g+1)%2 — distinct slots, so an early-arriving neighbor write never
-        collides with this device's own staging."""
-        # Back-pressure: before re-using a landing slot on the right
-        # neighbor (every slot is re-used from step 2 on), wait for its
-        # "slot free" signal — without this a fast sender can run 2+ steps
-        # ahead and clobber unconsumed data (caught by the interpret-mode
-        # tests; two slots alone are NOT a protocol).
+    def step(g, send_c, recv_c, *, accumulate):
+        """One ring step: stage chunk ``send_c`` and ship it right; fold the
+        chunk arriving from the left into output slot ``recv_c``."""
+        # Back-pressure: the right neighbor's landing slot g%2 is reused
+        # every 2 steps; wait for its "read done" token from step g-2
+        # before writing into it again. Without this a fast sender runs
+        # 2+ steps ahead and clobbers unconsumed data (two slots alone
+        # are NOT a protocol).
         if g >= 2:
-            pltpu.semaphore_wait(cap_sem.at[(g + 1) % 2], 1)
+            pltpu.semaphore_wait(cap_sem.at[g % 2], 1)
+        send_buf[...] = o_ref[pl.ds(send_c * rows, rows), :]
         rdma = pltpu.make_async_remote_copy(
-            src_ref=comm_buf.at[g % 2],
-            dst_ref=comm_buf.at[(g + 1) % 2],
-            send_sem=send_sem.at[g % 2],
-            recv_sem=recv_sem.at[(g + 1) % 2],
+            src_ref=send_buf,
+            dst_ref=recv_buf.at[g % 2],
+            send_sem=send_sem,
+            recv_sem=recv_sem.at[g % 2],
             device_id={axis: right},
         )
         rdma.start()
-        rdma.wait()  # my send done AND left neighbor's chunk delivered
-
-    def consumed(g):
-        """Tell the LEFT neighbor its landing slot on me is free again."""
-        pltpu.semaphore_signal(
-            cap_sem.at[(g + 1) % 2], inc=1, device_id={axis: left}
-        )
+        # Blocks on BOTH: my outgoing DMA finished reading send_buf (so the
+        # next step may restage it) AND the left neighbor's chunk arrived
+        # in recv_buf[g%2]. send_buf is never a remote-write target, so no
+        # neighbor progress can corrupt a send in flight.
+        rdma.wait()
+        incoming = _pvary(recv_buf[g % 2], (axis,))
+        if accumulate:
+            o_ref[pl.ds(recv_c * rows, rows), :] += incoming
+        else:
+            o_ref[pl.ds(recv_c * rows, rows), :] = incoming
+        # Landing slot consumed — only now may the left neighbor reuse it
+        # (its step g+2).
+        pltpu.semaphore_signal(cap_sem.at[g % 2], inc=1, device_id={axis: left})
 
     # Python loops, not fori_loop: p is static, and the step index must stay
     # a Python int so chunk indices are pure functions of the (device-
@@ -109,29 +120,28 @@ def _kernel(
     # replicated loop carry into varying address arithmetic.
     # ---- phase 1: reduce-scatter -----------------------------------------
     for s in range(p - 1):
-        send_c = lax.rem(i - s + p, p)
-        recv_c = lax.rem(i - s - 1 + 2 * p, p)
-        # Stage the running sum of send_c into the mailbox, ship it right.
-        comm_buf[s % 2] = o_ref[pl.ds(send_c * rows, rows), :]
-        ship(s)
-        o_ref[pl.ds(recv_c * rows, rows), :] += _vary(comm_buf[(s + 1) % 2], axis)
-        consumed(s)
+        step(
+            s,
+            send_c=lax.rem(i - s + p, p),
+            recv_c=lax.rem(i - s - 1 + 2 * p, p),
+            accumulate=True,
+        )
 
     # ---- phase 2: all-gather ---------------------------------------------
     # Device i now owns reduced chunk (i+1) mod p; circulate ownership.
     for s in range(p - 1):
-        g = (p - 1) + s  # continuous step counter across phases
-        send_c = lax.rem(i + 1 - s + 2 * p, p)
-        recv_c = lax.rem(i - s + 2 * p, p)
-        comm_buf[g % 2] = o_ref[pl.ds(send_c * rows, rows), :]
-        ship(g)
-        o_ref[pl.ds(recv_c * rows, rows), :] = _vary(comm_buf[(g + 1) % 2], axis)
-        consumed(g)
+        step(
+            (p - 1) + s,
+            send_c=lax.rem(i + 1 - s + 2 * p, p),
+            recv_c=lax.rem(i - s + 2 * p, p),
+            accumulate=False,
+        )
 
-    # Drain: the final two "slot free" signals have no matching send-side
-    # wait; absorb them so the semaphores return to zero for the next call.
+    # Drain: the final two "read done" tokens (one per slot, from steps
+    # total-1 and total-2) have no matching send-side wait; absorb them so
+    # the semaphores return to zero for the next call.
     pltpu.semaphore_wait(cap_sem.at[(total - 1) % 2], 1)
-    pltpu.semaphore_wait(cap_sem.at[total % 2], 1)
+    pltpu.semaphore_wait(cap_sem.at[(total - 2) % 2], 1)
 
 
 def _ring_allreduce_2d(x2d, *, axis: str, interpret: bool):
@@ -146,8 +156,9 @@ def _ring_allreduce_2d(x2d, *, axis: str, interpret: bool):
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, rows, _LANE), x2d.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((rows, _LANE), x2d.dtype),  # send staging (local-only)
+            pltpu.VMEM((2, rows, _LANE), x2d.dtype),  # receive mailbox
+            pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),  # per-slot capacity tokens
         ],
@@ -169,8 +180,12 @@ def ring_allreduce(x, axis: str, *, interpret: bool = False):
     CPU fake mesh — the semaphore-discipline sanitizer of SURVEY.md §6).
 
     Equivalent to ``lax.psum(x, axis)``; exists as the native tier and for
-    the GB/s benchmark.
+    the GB/s benchmark. On non-TPU backends (where Mosaic can't lower the
+    remote DMAs) the compiled path falls back to ``lax.psum`` — only
+    ``interpret=True`` runs the actual ring protocol off-TPU.
     """
+    if not interpret and jax.devices()[0].platform != "tpu":
+        return lax.psum(x, axis)
     p = lax.axis_size(axis)
     flat = jnp.ravel(x)
     n = flat.shape[0]

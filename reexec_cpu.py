@@ -21,22 +21,53 @@ import sys
 N_FAKE_DEVICES = 8
 
 
+def cpu_mesh_env(n_devices: int = N_FAKE_DEVICES) -> dict:
+    """A copy of ``os.environ`` rewritten for an ``n_devices`` fake CPU mesh.
+
+    Strips ``PALLAS_AXON_POOL_IPS`` (the sitecustomize trigger that force-
+    registers the single-chip axon backend and overrides ``JAX_PLATFORMS``)
+    and forces the host-platform device count — replacing any pre-existing
+    ``xla_force_host_platform_device_count`` flag, so a caller-supplied
+    smaller count cannot survive into the child.
+    """
+    import re
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disables axon registration
+    env["JAX_PLATFORMS"] = "cpu"
+    xla_flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        env.get("XLA_FLAGS", ""),
+    )
+    xla_flags += f" --xla_force_host_platform_device_count={n_devices}"
+    env["XLA_FLAGS"] = xla_flags.strip()
+    return env
+
+
 def reexec_onto_cpu_mesh_if_needed() -> None:
     if os.environ.get("MPIT_TEST_REEXEC") == "1":
         return
     if os.environ.get("MPIT_TEST_PLATFORM", "cpu") != "cpu":
         return
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # disables axon registration
-    env["JAX_PLATFORMS"] = "cpu"
-    xla_flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in xla_flags:
-        xla_flags += f" --xla_force_host_platform_device_count={N_FAKE_DEVICES}"
-    env["XLA_FLAGS"] = xla_flags.strip()
+    # Honor a caller-supplied device count (e.g. XLA_FLAGS=...=16 pytest)
+    # rather than forcing N_FAKE_DEVICES over it.
+    import re
+
+    m = re.search(
+        r"--xla_force_host_platform_device_count=(\d+)",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    env = cpu_mesh_env(int(m.group(1)) if m else N_FAKE_DEVICES)
     env["MPIT_TEST_REEXEC"] = "1"
     sys.stdout.flush()
     sys.stderr.flush()
     os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
 
 
-reexec_onto_cpu_mesh_if_needed()
+# Auto-run only when this module is being loaded by pytest itself (the
+# ``-p reexec_cpu`` early-plugin path, or a conftest import during startup).
+# Plain consumers of :func:`cpu_mesh_env` (e.g. ``__graft_entry__``) must be
+# able to import this module without being exec'd into a pytest run.
+if "_pytest.config" in sys.modules:
+    reexec_onto_cpu_mesh_if_needed()
