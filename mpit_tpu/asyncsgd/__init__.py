@@ -1,0 +1,41 @@
+"""mpit_tpu.asyncsgd — the application layer (the reference's L4).
+
+Reference capability (SURVEY.md §2 L3/L4, §3.2): the ``asyncsgd/``
+directory — ``pserver.lua``/``pclient.lua`` (the two-actor async
+parameter-server protocol), the goo optimizer wiring, and the MNIST/
+ImageNet training scripts launched under ``mpirun`` with a rank-role
+convention.
+
+TPU-native layout of the same surface:
+
+- :mod:`~mpit_tpu.asyncsgd.actors` — ``pserver``/``PClient`` parity actors
+  (A1/A2): the tagged-message protocol, run on the compat simulator.
+- :mod:`~mpit_tpu.asyncsgd.runner` — the shared harness: the SPMD
+  (north-star) path and the parity path, one call each.
+- :mod:`~mpit_tpu.asyncsgd.mnist` / :mod:`~mpit_tpu.asyncsgd.imagenet` /
+  :mod:`~mpit_tpu.asyncsgd.resnet` / :mod:`~mpit_tpu.asyncsgd.gpt2` —
+  the acceptance-ladder workload scripts (BASELINE.json configs #1–#5),
+  each a ``main(argv)`` entry point.
+- :mod:`~mpit_tpu.asyncsgd.config` — the dataclass/argparse option system
+  (the Lua ``opt`` table analogue).
+
+Launch (the ``mpirun -n P th script.lua`` analogue)::
+
+    python -m mpit_tpu.asyncsgd mnist --steps 500 --batch-size 64
+    python -m mpit_tpu.asyncsgd mnist --mode parity --nranks 5 --easgd true
+    python -m mpit_tpu.asyncsgd gpt2 --mesh data=4,model=2 --seq-len 1024
+"""
+
+from mpit_tpu.asyncsgd.actors import PClient, pserver, run_parameter_server
+from mpit_tpu.asyncsgd.config import TrainConfig, from_argv
+
+WORKLOADS = ("mnist", "imagenet", "resnet", "gpt2")
+
+__all__ = [
+    "PClient",
+    "pserver",
+    "run_parameter_server",
+    "TrainConfig",
+    "from_argv",
+    "WORKLOADS",
+]

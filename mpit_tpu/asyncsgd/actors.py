@@ -1,0 +1,180 @@
+"""Parameter-server parity actors — ``pserver.lua`` / ``pclient.lua`` re-done.
+
+Reference capability (SURVEY.md §3.2 A1/A2, §4.2): the server rank owns the
+canonical flattened parameter vector + goo state and services tagged client
+messages from ``ANY_SOURCE``; each client pushes gradients (Downpour) or
+exchanges elastic differences (EASGD) and pulls fresh params, overlapping
+communication via ``Isend``/``Irecv``.
+
+These actors reproduce that protocol *semantically* on the
+:mod:`mpit_tpu.compat` multi-rank simulator (the in-tree ``mpirun``
+analogue). They are the parity/porting tier: the TPU-native path is the
+collapsed SPMD step in :mod:`mpit_tpu.train.step` (BASELINE.json
+north-star), and :mod:`mpit_tpu.asyncsgd`'s workload scripts run either.
+
+Message protocol (tag-dispatched, like the reference's TAG_GRAD/TAG_FETCH):
+
+=========  ===========================  =============================
+tag        payload (client → server)    server reply
+=========  ===========================  =============================
+TAG_FETCH  ``[step]`` int32             params (``TAG_PARAM``)
+TAG_GRAD   flat gradient float32        — (Downpour: apply goo)
+TAG_DELTA  flat client params float32   pre-update center (``TAG_PARAM``);
+                                        then x̃ ← x̃ + α·(xᵢ − x̃)
+TAG_STOP   ``[step]`` int32             — (exit after one per client)
+=========  ===========================  =============================
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import numpy as np
+import optax
+
+from mpit_tpu import compat as mpiT
+
+TAG_FETCH = 11
+TAG_PARAM = 12
+TAG_GRAD = 13
+TAG_DELTA = 14
+TAG_STOP = 15
+
+SERVER_RANK = 0  # rank-role convention (SURVEY.md §3.2 A6): rank 0 serves
+
+
+def pserver(
+    init_flat: np.ndarray,
+    tx: optax.GradientTransformation,
+    *,
+    nclients: int,
+    easgd_alpha: float = 0.125,
+) -> np.ndarray:
+    """The server actor: run until every client sent ``TAG_STOP``.
+
+    Args:
+      init_flat: initial flattened parameter vector (the canonical copy).
+      tx: the goo transformation applied to pushed gradients (Downpour).
+      nclients: how many ``TAG_STOP`` messages end the loop.
+      easgd_alpha: center pull strength for ``TAG_DELTA`` exchanges.
+
+    Returns the final parameter (or EASGD center) vector.
+    """
+    params = jax.numpy.asarray(init_flat)
+    opt_state = tx.init(params)
+    update = jax.jit(tx.update)
+    apply = jax.jit(optax.apply_updates)
+
+    flat = np.asarray(init_flat, np.float32)
+    grad_buf = np.empty_like(flat)
+    ctrl_buf = np.empty((1,), np.int32)
+
+    stops = 0
+    while stops < nclients:
+        st = mpiT.Probe(mpiT.ANY_SOURCE, mpiT.ANY_TAG)
+        if st.tag == TAG_FETCH:
+            mpiT.Recv(ctrl_buf, src=st.source, tag=TAG_FETCH)
+            mpiT.Send(np.asarray(params, np.float32), dest=st.source, tag=TAG_PARAM)
+        elif st.tag == TAG_GRAD:
+            mpiT.Recv(grad_buf, src=st.source, tag=TAG_GRAD)
+            updates, opt_state = update(
+                jax.numpy.asarray(grad_buf), opt_state, params
+            )
+            params = apply(params, updates)
+        elif st.tag == TAG_DELTA:
+            mpiT.Recv(grad_buf, src=st.source, tag=TAG_DELTA)
+            center = np.asarray(params, np.float32)
+            # Reply with the pre-update center; both sides then move from
+            # the same (x_i, x̃) pair — the paper's async EASGD rule.
+            mpiT.Send(center, dest=st.source, tag=TAG_PARAM)
+            params = jax.numpy.asarray(
+                center + easgd_alpha * (grad_buf - center)
+            )
+        elif st.tag == TAG_STOP:
+            mpiT.Recv(ctrl_buf, src=st.source, tag=TAG_STOP)
+            stops += 1
+        else:  # unknown tag: consume to avoid deadlock, then fail loudly
+            mpiT.Recv(np.empty((st.count,), np.float32), src=st.source, tag=st.tag)
+            raise RuntimeError(f"pserver: unexpected tag {st.tag} from {st.source}")
+    return np.asarray(params, np.float32)
+
+
+class PClient:
+    """The client proxy linked into a worker's training loop.
+
+    ``fetch()`` pulls fresh params; ``push_grad()`` uploads a gradient
+    (Downpour); ``elastic_exchange()`` runs one EASGD round trip. ``fetch``
+    posts the receive before the request send and the (buffered) ``Isend``
+    of the gradient overlaps the next fetch — the reference's
+    ``Isend``/``Irecv`` overlap shape (SURVEY.md §4.2).
+    """
+
+    def __init__(self, flat_dim: int, *, server: int = SERVER_RANK):
+        self._server = server
+        self._param_buf = np.empty((flat_dim,), np.float32)
+        self._step = 0
+
+    def fetch(self) -> np.ndarray:
+        req = mpiT.Irecv(self._param_buf, src=self._server, tag=TAG_PARAM)
+        mpiT.Isend(
+            np.asarray([self._step], np.int32), dest=self._server, tag=TAG_FETCH
+        )
+        mpiT.Wait(req)
+        return self._param_buf
+
+    def push_grad(self, flat_grad: np.ndarray) -> None:
+        self._step += 1
+        mpiT.Isend(
+            np.asarray(flat_grad, np.float32), dest=self._server, tag=TAG_GRAD
+        )
+
+    def elastic_exchange(self, flat_params: np.ndarray, alpha: float) -> np.ndarray:
+        """One EASGD round trip; returns the client's pulled params."""
+        self._step += 1
+        req = mpiT.Irecv(self._param_buf, src=self._server, tag=TAG_PARAM)
+        mpiT.Isend(
+            np.asarray(flat_params, np.float32), dest=self._server, tag=TAG_DELTA
+        )
+        mpiT.Wait(req)
+        center = self._param_buf
+        return flat_params - alpha * (flat_params - center)
+
+    def stop(self) -> None:
+        mpiT.Isend(
+            np.asarray([self._step], np.int32), dest=self._server, tag=TAG_STOP
+        )
+
+
+def run_parameter_server(
+    init_flat: np.ndarray,
+    tx: optax.GradientTransformation,
+    client_fn: Callable[[PClient, int], object],
+    *,
+    nranks: int = 2,
+    easgd_alpha: float = 0.125,
+) -> list:
+    """Launch 1 pserver + ``nranks-1`` pclients — the ``mpirun -n P`` shape.
+
+    ``client_fn(client, worker_index)`` runs on each client rank with a
+    connected :class:`PClient`; its return value lands in the result list
+    at its rank. Rank 0's slot holds the server's final parameter vector.
+    """
+
+    def main():
+        mpiT.Init()
+        rank = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        try:
+            if rank == SERVER_RANK:
+                return pserver(
+                    init_flat, tx, nclients=nranks - 1, easgd_alpha=easgd_alpha
+                )
+            client = PClient(init_flat.shape[0])
+            try:
+                return client_fn(client, rank - 1)
+            finally:
+                client.stop()
+        finally:
+            mpiT.Finalize()
+
+    return mpiT.run(main, nranks)

@@ -1,0 +1,99 @@
+"""Config/flag system for the asyncsgd application layer.
+
+The reference parses Lua option tables from the command line in its
+``asyncsgd/`` scripts (``opt.lr``, ``opt.rank`` conventions; SURVEY.md §6
+"Config / flag system") — deliberately lightweight. Matching that: each
+workload is configured by a plain dataclass, and the argparse interface is
+generated from its fields (``--lr 0.05 --steps 200 --mesh data=4,model=2``).
+No heavyweight config framework.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Any, Mapping, Type, TypeVar
+
+T = TypeVar("T")
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Options shared by every workload script (the ``opt`` table analogue).
+
+    ``mode`` selects the execution model:
+
+    - ``"spmd"`` (default): the TPU-native path — one jitted SPMD step over
+      the mesh, goo state sharded when ``zero1`` (the north-star collapse of
+      the pserver/pclient protocol).
+    - ``"parity"``: the reference-shaped path — 1 parameter-server rank +
+      ``nranks-1`` client ranks exchanging tagged messages on the
+      :mod:`mpit_tpu.compat` simulator (the ``mpirun -n P`` analogue), for
+      semantics/parity work, not performance.
+    """
+
+    mode: str = "spmd"  # spmd | parity
+    steps: int = 200
+    batch_size: int = 64  # global (split across data-parallel devices/clients)
+    lr: float = 0.05
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    zero1: bool = True  # shard goo state across the data axis (SPMD mode)
+    easgd: bool = False  # elastic-averaging dynamics instead of Downpour
+    easgd_alpha: float = 0.125
+    sync_every: int = 1  # parity mode: client steps between server exchanges
+    nranks: int = 2  # parity mode: 1 pserver + (nranks-1) pclients
+    mesh: str = ""  # SPMD mesh, e.g. "data=4,model=2"; "" = all-data
+    log_every: int = 50
+    ckpt_dir: str = ""  # orbax checkpoint directory ("" = no checkpoints)
+    ckpt_every: int = 0
+    eval_batch: int = 256
+    seed: int = 0
+
+    def mesh_shape(self) -> dict[str, int] | None:
+        """Parse ``"data=4,model=2"`` → ``{"data": 4, "model": 2}``."""
+        if not self.mesh:
+            return None
+        out: dict[str, int] = {}
+        for part in self.mesh.split(","):
+            k, _, v = part.partition("=")
+            out[k.strip()] = int(v)
+        return out
+
+
+def _str2bool(v: str) -> bool:
+    if v.lower() in ("1", "true", "yes", "on"):
+        return True
+    if v.lower() in ("0", "false", "no", "off"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected a boolean, got {v!r}")
+
+
+def add_dataclass_args(parser: argparse.ArgumentParser, cls: Type[Any]) -> None:
+    """Add one ``--flag`` per dataclass field (bools accept true/false)."""
+    for f in dataclasses.fields(cls):
+        name = "--" + f.name.replace("_", "-")
+        default = (
+            f.default
+            if f.default is not dataclasses.MISSING
+            else f.default_factory()  # type: ignore[misc]
+        )
+        typ = _str2bool if f.type in (bool, "bool") else type(default)
+        parser.add_argument(name, type=typ, default=default, help=f"({default})")
+
+
+def from_argv(
+    cls: Type[T],
+    argv: list[str] | None = None,
+    *,
+    prog: str | None = None,
+    overrides: Mapping[str, Any] | None = None,
+) -> T:
+    """Build a config dataclass from CLI args (+ programmatic overrides)."""
+    parser = argparse.ArgumentParser(prog=prog, description=cls.__doc__)
+    add_dataclass_args(parser, cls)
+    ns = parser.parse_args(argv)
+    kw = {f.name: getattr(ns, f.name) for f in dataclasses.fields(cls)}
+    if overrides:
+        kw.update(overrides)
+    return cls(**kw)

@@ -1,0 +1,77 @@
+"""ImageNet AlexNet — baseline config #3 (and the headline benchmark).
+
+Reference (SURVEY.md §3.2 A5): Torch7 AlexNet + ImageNet pipeline through
+the same pserver/pclient protocol — the reference's large-scale workload,
+and the metric BASELINE.json tracks (AlexNet ImageNet images/sec; ≥58%
+top-1 north-star on 32 chips).
+
+``--mode spmd`` is the path that scales (sync DP + ZeRO-1 goo sharding);
+``--mode parity`` runs the reference-shaped async protocol at toy sizes.
+``--image-size``/``--num-classes`` shrink the workload for fake-mesh tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from mpit_tpu.asyncsgd import runner
+from mpit_tpu.asyncsgd.config import TrainConfig, from_argv
+from mpit_tpu.data import synthetic_imagenet
+from mpit_tpu.models import AlexNet
+
+
+@dataclasses.dataclass
+class ImagenetConfig(TrainConfig):
+    image_size: int = 224
+    num_classes: int = 1000
+    lr: float = 0.01
+
+
+def main(argv: list[str] | None = None, **overrides) -> dict:
+    cfg = from_argv(
+        ImagenetConfig, argv, prog="asyncsgd.imagenet", overrides=overrides
+    )
+    print(runner.describe(cfg, "imagenet-alexnet"))
+    dataset = synthetic_imagenet(
+        image_size=cfg.image_size, num_classes=cfg.num_classes, seed=cfg.seed
+    )
+    model = AlexNet(num_classes=cfg.num_classes)
+
+    if cfg.mode == "parity":
+        return runner.run_parity_classifier(cfg, model, dataset)
+
+    def init_params():
+        params = model.init(
+            jax.random.key(cfg.seed),
+            jnp.zeros((1, cfg.image_size, cfg.image_size, 3)),
+        )["params"]
+        return params, ()
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["image"])
+        loss = runner.softmax_xent(logits, batch["label"])
+        return loss, {"accuracy": runner.accuracy(logits, batch["label"])}
+
+    def eval_fn(params, extra, batch):
+        del extra
+        logits = model.apply({"params": params}, batch["image"])
+        return {
+            "loss": runner.softmax_xent(logits, batch["label"]),
+            "accuracy": runner.accuracy(logits, batch["label"]),
+        }
+
+    return runner.run_spmd(
+        cfg,
+        dataset.batches(cfg.batch_size),
+        loss_fn,
+        init_params,
+        eval_fn=eval_fn,
+        eval_batch=dataset.eval_batch(cfg.eval_batch),
+    )
+
+
+if __name__ == "__main__":
+    print(main())

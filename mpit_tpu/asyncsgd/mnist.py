@@ -1,0 +1,72 @@
+"""MNIST LeNet — baseline configs #1 and #2.
+
+Reference (SURVEY.md §3.2 A4; BASELINE.json configs): Torch7 scripts under
+``asyncsgd/`` training a LeNet-style convnet on MNIST through the
+pserver/pclient loop — "1 pserver + 1 pclient" is the smallest full
+system, "4 pclients" exercises Bcast/Allreduce semantics.
+
+Here both shapes run from one script:
+
+- ``--mode spmd`` (default): the TPU-native collapsed step; config #2's
+  4-way data parallelism is ``--mesh data=4`` on a ≥4-device mesh.
+- ``--mode parity --nranks 2`` / ``--nranks 5``: the reference-shaped
+  1-server + N-client protocol on the compat simulator (Downpour, or
+  ``--easgd true`` for the elastic-averaging variant).
+
+Data is synthetic-MNIST (28×28×1, 10 classes, prototype+noise — this
+environment has no network; SURVEY.md §8.1) behind the same iterator
+interface a real MNIST loader plugs into.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mpit_tpu.asyncsgd import runner
+from mpit_tpu.asyncsgd.config import TrainConfig, from_argv
+from mpit_tpu.data import synthetic_mnist
+from mpit_tpu.models import LeNet
+
+
+def main(argv: list[str] | None = None, **overrides) -> dict:
+    cfg = from_argv(TrainConfig, argv, prog="asyncsgd.mnist", overrides=overrides)
+    print(runner.describe(cfg, "mnist-lenet"))
+    dataset = synthetic_mnist(seed=cfg.seed)
+    model = LeNet()
+
+    if cfg.mode == "parity":
+        return runner.run_parity_classifier(cfg, model, dataset)
+
+    def init_params():
+        params = model.init(
+            jax.random.key(cfg.seed), jnp.zeros((1, 28, 28, 1))
+        )["params"]
+        return params, ()
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["image"])
+        loss = runner.softmax_xent(logits, batch["label"])
+        return loss, {"accuracy": runner.accuracy(logits, batch["label"])}
+
+    def eval_fn(params, extra, batch):
+        del extra
+        logits = model.apply({"params": params}, batch["image"])
+        return {
+            "loss": runner.softmax_xent(logits, batch["label"]),
+            "accuracy": runner.accuracy(logits, batch["label"]),
+        }
+
+    return runner.run_spmd(
+        cfg,
+        dataset.batches(cfg.batch_size),
+        loss_fn,
+        init_params,
+        eval_fn=eval_fn,
+        eval_batch=dataset.eval_batch(cfg.eval_batch),
+    )
+
+
+if __name__ == "__main__":
+    out = main()
+    print(out)

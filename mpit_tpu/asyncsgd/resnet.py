@@ -1,0 +1,88 @@
+"""ImageNet ResNet-50 — baseline config #4: sync allreduce + sharded goo.
+
+Beyond the reference (which stops at AlexNet; SURVEY.md §3.3): this config
+exists to exercise exactly the north-star machinery — the synchronous
+``psum`` gradient path with the goo optimizer state sharded across chips
+(ZeRO-1). BatchNorm batch statistics ride the train step's ``stateful``
+path and are pmean-synced across replicas each step.
+
+SPMD-only: the async parity protocol has no story for BN state (the
+reference never had BN), so ``--mode parity`` is rejected rather than
+silently wrong.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from mpit_tpu.asyncsgd import runner
+from mpit_tpu.asyncsgd.config import TrainConfig, from_argv
+from mpit_tpu.data import synthetic_imagenet
+from mpit_tpu.models import ResNet50
+
+
+@dataclasses.dataclass
+class ResnetConfig(TrainConfig):
+    image_size: int = 224
+    num_classes: int = 1000
+    lr: float = 0.1
+    weight_decay: float = 1e-4
+
+
+def main(argv: list[str] | None = None, **overrides) -> dict:
+    cfg = from_argv(ResnetConfig, argv, prog="asyncsgd.resnet", overrides=overrides)
+    if cfg.mode == "parity":
+        raise SystemExit(
+            "resnet50 is SPMD-only: the async parity protocol predates "
+            "BatchNorm and has no defined semantics for its running stats"
+        )
+    print(runner.describe(cfg, "imagenet-resnet50"))
+    dataset = synthetic_imagenet(
+        image_size=cfg.image_size, num_classes=cfg.num_classes, seed=cfg.seed
+    )
+    model = ResNet50(num_classes=cfg.num_classes)
+
+    def init_params():
+        variables = model.init(
+            jax.random.key(cfg.seed),
+            jnp.zeros((2, cfg.image_size, cfg.image_size, 3)),
+        )
+        return variables["params"], variables["batch_stats"]
+
+    def loss_fn(params, batch_stats, batch):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["image"],
+            mutable=["batch_stats"],
+        )
+        loss = runner.softmax_xent(logits, batch["label"])
+        aux = {"accuracy": runner.accuracy(logits, batch["label"])}
+        return loss, aux, mutated["batch_stats"]
+
+    def eval_fn(params, batch_stats, batch):
+        logits, _ = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["image"],
+            mutable=["batch_stats"],
+        )
+        return {
+            "loss": runner.softmax_xent(logits, batch["label"]),
+            "accuracy": runner.accuracy(logits, batch["label"]),
+        }
+
+    return runner.run_spmd(
+        cfg,
+        dataset.batches(cfg.batch_size),
+        loss_fn,
+        init_params,
+        stateful=True,
+        eval_fn=eval_fn,
+        eval_batch=dataset.eval_batch(cfg.eval_batch),
+    )
+
+
+if __name__ == "__main__":
+    print(main())

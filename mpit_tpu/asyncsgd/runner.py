@@ -1,0 +1,233 @@
+"""Shared run harness for the asyncsgd workload scripts.
+
+Two execution paths per workload (selected by ``TrainConfig.mode``):
+
+- :func:`run_spmd` — the TPU-native path: one jitted SPMD step over the
+  mesh (fwd/bwd → gradient combine → goo update), ZeRO-1 sharded state,
+  prefetched sharded batches, optional orbax checkpointing. This is the
+  north-star collapse of the reference's pserver/pclient protocol.
+- :func:`run_parity_classifier` — the reference-shaped path: 1 pserver +
+  N pclients exchanging tagged messages on the compat simulator
+  (Downpour or EASGD), for semantics parity, not performance.
+
+Both return a plain metrics dict so tests and the launcher can assert on
+them (loss trajectory, eval accuracy, throughput).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import mpit_tpu
+from mpit_tpu import opt as gopt
+from mpit_tpu.asyncsgd import actors
+from mpit_tpu.asyncsgd.config import TrainConfig
+from mpit_tpu.data import Prefetcher
+from mpit_tpu.train import (
+    CheckpointManager,
+    MetricLogger,
+    Throughput,
+    make_eval_step,
+    make_train_step,
+)
+
+
+def softmax_xent(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def accuracy(logits, labels):
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+
+
+def build_tx(cfg: TrainConfig, *, axis: str | None = None):
+    """The goo transformation for a config (Downpour-SGD or EASGD chain)."""
+    base = gopt.goo(
+        cfg.lr, cfg.momentum, weight_decay=cfg.weight_decay
+    )
+    if cfg.easgd:
+        # The SPMD spelling of the reference's elastic dynamics: params
+        # vary per-device (local SGD), the center is the pmean — the
+        # whole pserver reduced to a collective (opt/goo.py).
+        return optax.chain(base, gopt.elastic_average(cfg.easgd_alpha, axis=axis))
+    return base
+
+
+def run_spmd(
+    cfg: TrainConfig,
+    batches,
+    loss_fn: Callable,
+    init_params: Callable,
+    *,
+    stateful: bool = False,
+    eval_fn: Callable | None = None,
+    eval_batch: dict | None = None,
+) -> dict:
+    """Drive the jitted SPMD train step for ``cfg.steps`` steps.
+
+    Args:
+      batches: host-side global-batch iterator (numpy pytrees).
+      loss_fn: ``(params, batch) -> (loss, aux)`` or the stateful form
+        (see ``make_train_step``).
+      init_params: ``() -> (params, extra)``.
+      eval_fn / eval_batch: optional ``(params, extra, batch) -> metrics``
+        evaluated at the end on a held-out batch.
+    """
+    world = mpit_tpu.init(cfg.mesh_shape())
+    axis = "data"
+    params, extra = init_params()
+    # EASGD under SPMD needs per-device param divergence; plain DP params
+    # are replicated, so elastic dynamics apply but params stay in sync —
+    # documented collapse (goo.elastic_average docstring).
+    tx = build_tx(cfg, axis=axis)
+
+    init_fn, step_fn, state_specs = make_train_step(
+        loss_fn, tx, world, axis=axis, zero1=cfg.zero1, stateful=stateful
+    )
+    state = init_fn(params, extra)
+
+    ckpt = None
+    if cfg.ckpt_dir:
+        ckpt = CheckpointManager(cfg.ckpt_dir, world)
+        if ckpt.latest_step() is not None:
+            state = ckpt.restore(state, state_specs(params, extra))
+
+    logger = MetricLogger()
+    meter = Throughput()
+    losses: list[float] = []
+    start_step = int(state.step)
+    with Prefetcher(world, batches, axis=axis) as stream:
+        for i, batch in enumerate(stream):
+            step = start_step + i
+            if step >= cfg.steps:
+                break
+            state, metrics = step_fn(state, batch)
+            rate = meter.tick(cfg.batch_size)
+            if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+                loss = float(metrics["loss"])
+                losses.append(loss)
+                logger.log(step + 1, {**{k: float(v) for k, v in metrics.items()},
+                                      "items_per_sec": rate})
+            if ckpt and cfg.ckpt_every and (step + 1) % cfg.ckpt_every == 0:
+                ckpt.save(step + 1, state)
+    if ckpt:
+        ckpt.wait()
+
+    out = {
+        "mode": "spmd",
+        "world": repr(mpit_tpu.comm.get_world()),
+        "steps": int(state.step),
+        "losses": losses,
+        "final_loss": losses[-1] if losses else float("nan"),
+    }
+    if eval_fn is not None and eval_batch is not None:
+        ev = make_eval_step(eval_fn, world, axis=axis)
+        from mpit_tpu.data import shard_batch
+
+        metrics = ev(state, shard_batch(world, eval_batch, axis=axis))
+        out["eval"] = {k: float(v) for k, v in metrics.items()}
+    return out
+
+
+def run_parity_classifier(cfg: TrainConfig, model, dataset) -> dict:
+    """The reference-shaped path: 1 pserver + N pclients on the simulator.
+
+    Downpour (default): clients fetch params, push gradients; the server
+    applies goo per message (SURVEY.md §4.2's two hot loops). EASGD
+    (``cfg.easgd``): clients run local goo steps and exchange elastic
+    deltas with the server's center every ``cfg.sync_every`` steps.
+    """
+    nclients = max(cfg.nranks - 1, 1)
+    sample = dataset.eval_batch(1)
+    params0 = model.init(
+        jax.random.key(cfg.seed), jnp.zeros_like(jnp.asarray(sample["image"]))
+    )["params"]
+    flat0, unravel = jax.flatten_util.ravel_pytree(params0)
+    flat0 = np.asarray(flat0, np.float32)
+
+    @jax.jit
+    def loss_and_grad(flat, batch):
+        def f(fl):
+            logits = model.apply({"params": unravel(fl)}, batch["image"])
+            return softmax_xent(logits, batch["label"])
+
+        return jax.value_and_grad(f)(flat)
+
+    server_tx = gopt.goo(cfg.lr, cfg.momentum, weight_decay=cfg.weight_decay)
+    local_tx = gopt.goo(cfg.lr, cfg.momentum, weight_decay=cfg.weight_decay)
+
+    @jax.jit
+    def local_step(flat, opt_state, batch):
+        loss, g = loss_and_grad(flat, batch)
+        updates, opt_state = local_tx.update(g, opt_state, flat)
+        return optax.apply_updates(flat, updates), opt_state, loss
+
+    steps_per_client = max(cfg.steps // nclients, 1)
+    per_client_batch = max(cfg.batch_size // nclients, 1)
+
+    def client_fn(client: actors.PClient, widx: int):
+        stream = dataset.batches(per_client_batch, seed=cfg.seed + 100 + widx)
+        losses = []
+        if cfg.easgd:
+            flat = jnp.asarray(flat0)
+            opt_state = local_tx.init(flat)
+            for step in range(steps_per_client):
+                flat, opt_state, loss = local_step(flat, opt_state, next(stream))
+                if (step + 1) % cfg.sync_every == 0:
+                    flat = jnp.asarray(
+                        client.elastic_exchange(
+                            np.asarray(flat, np.float32), cfg.easgd_alpha
+                        )
+                    )
+                losses.append(float(loss))
+        else:
+            for _ in range(steps_per_client):
+                flat = jnp.asarray(client.fetch().copy())
+                loss, g = loss_and_grad(flat, next(stream))
+                client.push_grad(np.asarray(g, np.float32))
+                losses.append(float(loss))
+        return losses
+
+    results = actors.run_parameter_server(
+        flat0,
+        server_tx,
+        client_fn,
+        nranks=nclients + 1,
+        easgd_alpha=cfg.easgd_alpha,
+    )
+    final_flat = results[actors.SERVER_RANK]
+    client_losses = results[1:]
+
+    # Final-model eval with the server's canonical params.
+    eval_b = dataset.eval_batch(cfg.eval_batch)
+    logits = model.apply(
+        {"params": unravel(jnp.asarray(final_flat))}, jnp.asarray(eval_b["image"])
+    )
+    acc = float(accuracy(logits, jnp.asarray(eval_b["label"])))
+    eval_loss = float(softmax_xent(logits, jnp.asarray(eval_b["label"])))
+    return {
+        "mode": "parity",
+        "protocol": "easgd" if cfg.easgd else "downpour",
+        "nranks": cfg.nranks,
+        "losses": [sum(c) / len(c) for c in zip(*client_losses)]
+        if client_losses
+        else [],
+        "first_loss": client_losses[0][0],
+        "final_loss": client_losses[0][-1],
+        "eval": {"accuracy": acc, "loss": eval_loss},
+    }
+
+
+def describe(cfg: TrainConfig, workload: str) -> str:
+    fields = ", ".join(
+        f"{f.name}={getattr(cfg, f.name)!r}" for f in dataclasses.fields(cfg)
+    )
+    return f"[asyncsgd:{workload}] {fields}"

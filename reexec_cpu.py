@@ -21,27 +21,31 @@ import sys
 N_FAKE_DEVICES = 8
 
 
-def cpu_mesh_env(n_devices: int = N_FAKE_DEVICES) -> dict:
-    """A copy of ``os.environ`` rewritten for an ``n_devices`` fake CPU mesh.
+_COUNT_FLAG = r"--xla_force_host_platform_device_count=(\d+)"
+
+
+def cpu_mesh_env(n_devices: int | None = None) -> dict:
+    """A copy of ``os.environ`` rewritten for a fake-CPU-mesh child process.
 
     Strips ``PALLAS_AXON_POOL_IPS`` (the sitecustomize trigger that force-
     registers the single-chip axon backend and overrides ``JAX_PLATFORMS``)
-    and forces the host-platform device count — replacing any pre-existing
-    ``xla_force_host_platform_device_count`` flag, so a caller-supplied
-    smaller count cannot survive into the child.
+    and sets the host-platform device count. An explicit ``n_devices``
+    replaces any pre-existing ``xla_force_host_platform_device_count`` flag;
+    ``None`` preserves a caller-supplied count (defaulting to
+    ``N_FAKE_DEVICES`` when none is set).
     """
     import re
 
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # disables axon registration
     env["JAX_PLATFORMS"] = "cpu"
-    xla_flags = re.sub(
-        r"--xla_force_host_platform_device_count=\d+",
-        "",
-        env.get("XLA_FLAGS", ""),
-    )
-    xla_flags += f" --xla_force_host_platform_device_count={n_devices}"
-    env["XLA_FLAGS"] = xla_flags.strip()
+    flags = env.get("XLA_FLAGS", "")
+    if n_devices is None:
+        m = re.search(_COUNT_FLAG, flags)
+        n_devices = int(m.group(1)) if m else N_FAKE_DEVICES
+    flags = re.sub(_COUNT_FLAG, "", flags)
+    flags += f" --xla_force_host_platform_device_count={n_devices}"
+    env["XLA_FLAGS"] = flags.strip()
     return env
 
 
@@ -50,24 +54,18 @@ def reexec_onto_cpu_mesh_if_needed() -> None:
         return
     if os.environ.get("MPIT_TEST_PLATFORM", "cpu") != "cpu":
         return
-    # Honor a caller-supplied device count (e.g. XLA_FLAGS=...=16 pytest)
-    # rather than forcing N_FAKE_DEVICES over it.
-    import re
-
-    m = re.search(
-        r"--xla_force_host_platform_device_count=(\d+)",
-        os.environ.get("XLA_FLAGS", ""),
-    )
-    env = cpu_mesh_env(int(m.group(1)) if m else N_FAKE_DEVICES)
+    env = cpu_mesh_env()  # None: honor a caller-supplied device count
     env["MPIT_TEST_REEXEC"] = "1"
     sys.stdout.flush()
     sys.stderr.flush()
     os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
 
 
-# Auto-run only when this module is being loaded by pytest itself (the
-# ``-p reexec_cpu`` early-plugin path, or a conftest import during startup).
-# Plain consumers of :func:`cpu_mesh_env` (e.g. ``__graft_entry__``) must be
-# able to import this module without being exec'd into a pytest run.
-if "_pytest.config" in sys.modules:
+# Auto-run only when pytest is actually driving this process (the
+# ``-p reexec_cpu`` early-plugin path: argv[0] is the pytest console script
+# or pytest/__main__.py under ``python -m pytest``). Checking for pytest in
+# sys.modules is NOT enough — any program that merely imported pytest would
+# be silently exec'd into a test run when it imports this module (e.g.
+# ``__graft_entry__`` importing :func:`cpu_mesh_env` at runtime).
+if "pytest" in sys.argv[0]:
     reexec_onto_cpu_mesh_if_needed()

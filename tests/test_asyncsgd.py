@@ -1,0 +1,134 @@
+"""Tests for the asyncsgd application layer (SURVEY.md §3.2 A1–A6).
+
+Mirrors the reference's integration-test strategy (SURVEY.md §5.1): the
+MNIST scripts double as the smallest full-system test — here each baseline
+config runs for a few steps at toy sizes on the fake 8-device mesh, and
+the parity path runs the actual 1-pserver + N-pclient tagged-message
+protocol on the compat simulator.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mpit_tpu.asyncsgd import TrainConfig, from_argv
+from mpit_tpu.asyncsgd import __main__ as launcher
+from mpit_tpu.asyncsgd import gpt2, imagenet, mnist, resnet
+
+
+class TestConfig:
+    def test_from_argv_defaults_and_flags(self):
+        cfg = from_argv(
+            TrainConfig,
+            ["--steps", "7", "--easgd", "true", "--mesh", "data=2,model=4"],
+        )
+        assert cfg.steps == 7
+        assert cfg.easgd is True
+        assert cfg.mesh_shape() == {"data": 2, "model": 4}
+        assert from_argv(TrainConfig, []).mesh_shape() is None
+
+    def test_launcher_rejects_unknown_workload(self):
+        assert launcher.main(["no-such-model"]) == 2
+
+
+class TestMnist:
+    """Baseline configs #1/#2 — the minimum end-to-end slice (SURVEY §8.3)."""
+
+    def test_spmd_learns(self):
+        out = mnist.main(
+            ["--steps", "30", "--batch-size", "32", "--log-every", "10"]
+        )
+        assert out["mode"] == "spmd"
+        assert out["steps"] == 30
+        assert out["final_loss"] < 0.5 < out["losses"][0]
+        assert out["eval"]["accuracy"] > 0.7
+
+    def test_parity_downpour_1server_1client(self):
+        # Literally baseline config #1: 1 pserver + 1 pclient.
+        out = mnist.main(
+            ["--mode", "parity", "--nranks", "2", "--steps", "40",
+             "--batch-size", "32"]
+        )
+        assert out["protocol"] == "downpour"
+        assert out["final_loss"] < out["first_loss"]
+        assert out["eval"]["accuracy"] > 0.5
+
+    def test_parity_easgd_multiclient(self):
+        out = mnist.main(
+            ["--mode", "parity", "--nranks", "3", "--steps", "60",
+             "--batch-size", "32", "--easgd", "true", "--sync-every", "4"]
+        )
+        assert out["protocol"] == "easgd"
+        assert out["final_loss"] < 1.0
+        assert out["eval"]["accuracy"] > 0.5
+
+    def test_spmd_checkpoint_resume(self, tmp_path):
+        args = [
+            "--steps", "10", "--batch-size", "16", "--log-every", "5",
+            "--ckpt-dir", str(tmp_path / "ck"), "--ckpt-every", "5",
+        ]
+        first = mnist.main(args)
+        assert first["steps"] == 10
+        resumed = mnist.main(
+            [a if a != "10" else "14" for a in args]  # steps 10 → 14
+        )
+        # Restored from step 10 and advanced only the remaining 4 steps.
+        assert resumed["steps"] == 14
+
+
+class TestImagenet:
+    def test_spmd_micro_runs(self):
+        out = imagenet.main(
+            ["--steps", "4", "--batch-size", "16", "--image-size", "64",
+             "--num-classes", "8", "--log-every", "2", "--eval-batch", "16",
+             "--lr", "0.001"]
+        )
+        assert out["steps"] == 4
+        assert np.isfinite(out["final_loss"])
+
+    def test_parity_micro_runs(self):
+        out = imagenet.main(
+            ["--mode", "parity", "--nranks", "2", "--steps", "6",
+             "--batch-size", "8", "--image-size", "64", "--num-classes", "8",
+             "--lr", "0.001", "--eval-batch", "16"]
+        )
+        assert out["protocol"] == "downpour"
+        assert np.isfinite(out["final_loss"])
+
+
+class TestResnet:
+    def test_spmd_stateful_micro_runs(self):
+        out = resnet.main(
+            ["--steps", "3", "--batch-size", "16", "--image-size", "32",
+             "--num-classes", "8", "--log-every", "1", "--eval-batch", "16",
+             "--lr", "0.01"]
+        )
+        assert out["steps"] == 3
+        assert np.isfinite(out["final_loss"])
+
+    def test_parity_rejected(self):
+        with pytest.raises(SystemExit):
+            resnet.main(["--mode", "parity"])
+
+
+class TestGPT2:
+    TINY = [
+        "--batch-size", "8", "--seq-len", "32", "--vocab-size", "128",
+        "--num-layers", "2", "--num-heads", "2", "--d-model", "32",
+        "--log-every", "5",
+    ]
+
+    def test_shard_map_tier_learns(self):
+        out = gpt2.main(["--steps", "20", *self.TINY])
+        assert out["tier"] == "shard_map+zero1"
+        assert out["final_loss"] < out["uniform_loss"] + 0.05
+
+    def test_pjit_tp_tier_matches_dp(self):
+        dp = gpt2.main(["--steps", "8", *self.TINY])
+        tp = gpt2.main(["--steps", "8", "--mesh", "data=4,model=2", *self.TINY])
+        assert tp["tier"] == "pjit-tp"
+        # Same optimizer/config/data stream: the tiers must agree closely.
+        np.testing.assert_allclose(
+            tp["final_loss"], dp["final_loss"], rtol=1e-3
+        )
