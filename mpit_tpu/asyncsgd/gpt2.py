@@ -40,11 +40,17 @@ class GPT2TrainConfig(TrainConfig):
     num_heads: int = 12
     d_model: int = 768
     remat: bool = False
+    flash: bool = False  # Pallas flash-attention inner kernel (TPU)
     lr: float = 3e-4
     batch_size: int = 8
     fsdp_axis: str = ""  # e.g. "data" to compose ZeRO-3 with TP
 
     def model_config(self) -> GPT2Config:
+        kw = {}
+        if self.flash:
+            from mpit_tpu.ops import flash_attention
+
+            kw["attention_fn"] = flash_attention
         return GPT2Config(
             vocab_size=self.vocab_size,
             max_seq_len=self.seq_len,
@@ -52,6 +58,7 @@ class GPT2TrainConfig(TrainConfig):
             num_heads=self.num_heads,
             d_model=self.d_model,
             remat=self.remat,
+            **kw,
         )
 
 
@@ -82,35 +89,29 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
     batches = dataset.batches(cfg.batch_size, cfg.seq_len)
 
     if not mesh_shape or "model" not in mesh_shape:
-        # shard_map tier: plain sync DP + ZeRO-1 — reuse the common runner
-        # but with the adam-family tx (override build_tx via cfg fields is
-        # SGD-shaped, so drive the loop here for the correct optimizer).
-        world = mpit_tpu.init(mesh_shape)
-        from mpit_tpu.train import make_train_step
-
-        init_fn, step_fn, _ = make_train_step(
-            loss_fn, tx, world, zero1=cfg.zero1
+        # shard_map tier: plain sync DP + ZeRO-1 via the common runner
+        # (checkpoint/resume included), with the adam-family tx override.
+        out = runner.run_spmd(
+            cfg,
+            batches,
+            loss_fn,
+            init_params,
+            tx=tx,
+            items_per_batch=cfg.batch_size * cfg.seq_len,
         )
-        params, _ = init_params()
-        state = init_fn(params)
-        from mpit_tpu.data import Prefetcher
-
-        logger, meter, losses = MetricLogger(), Throughput(), []
-        with Prefetcher(world, batches) as stream:
-            for step, batch in enumerate(stream):
-                if step >= cfg.steps:
-                    break
-                state, metrics = step_fn(state, batch)
-                rate = meter.tick(cfg.batch_size * cfg.seq_len)
-                if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
-                    losses.append(float(metrics["loss"]))
-                    logger.log(
-                        step + 1,
-                        {"loss": losses[-1], "tokens_per_sec": rate},
-                    )
-        tier = "shard_map+zero1"
+        out.update(
+            tier="shard_map+zero1",
+            uniform_loss=dataset.uniform_loss,
+            optimal_loss=dataset.optimal_loss,
+        )
+        return out
     else:
         # GSPMD/pjit tier: TP (+ optional FSDP) via sharding rules.
+        if cfg.ckpt_dir:
+            raise SystemExit(
+                "gpt2: --ckpt-dir is not yet supported on the pjit TP tier "
+                "(use the shard_map tier, i.e. a mesh without a model axis)"
+            )
         world = mpit_tpu.init(mesh_shape)
         init_fn, step_fn, _ = make_pjit_train_step(
             loss_fn,

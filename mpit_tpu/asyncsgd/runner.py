@@ -68,6 +68,8 @@ def run_spmd(
     init_params: Callable,
     *,
     stateful: bool = False,
+    tx=None,
+    items_per_batch: int | None = None,
     eval_fn: Callable | None = None,
     eval_batch: dict | None = None,
 ) -> dict:
@@ -78,6 +80,10 @@ def run_spmd(
       loss_fn: ``(params, batch) -> (loss, aux)`` or the stateful form
         (see ``make_train_step``).
       init_params: ``() -> (params, extra)``.
+      tx: optax transform override (default: :func:`build_tx` from the
+        config's SGD-family fields).
+      items_per_batch: units for the throughput meter (default
+        ``cfg.batch_size``; pass tokens-per-batch for LM workloads).
       eval_fn / eval_batch: optional ``(params, extra, batch) -> metrics``
         evaluated at the end on a held-out batch.
     """
@@ -87,7 +93,8 @@ def run_spmd(
     # EASGD under SPMD needs per-device param divergence; plain DP params
     # are replicated, so elastic dynamics apply but params stay in sync —
     # documented collapse (goo.elastic_average docstring).
-    tx = build_tx(cfg, axis=axis)
+    if tx is None:
+        tx = build_tx(cfg, axis=axis)
 
     init_fn, step_fn, state_specs = make_train_step(
         loss_fn, tx, world, axis=axis, zero1=cfg.zero1, stateful=stateful
@@ -104,13 +111,19 @@ def run_spmd(
     meter = Throughput()
     losses: list[float] = []
     start_step = int(state.step)
+    # Resume continues the stream, not restarts it: skip the batches the
+    # checkpointed steps already consumed so the resumed trajectory matches
+    # an uninterrupted run (streams here are deterministic generators).
+    for _ in range(start_step):
+        next(batches)
+    items = items_per_batch or cfg.batch_size
     with Prefetcher(world, batches, axis=axis) as stream:
         for i, batch in enumerate(stream):
             step = start_step + i
             if step >= cfg.steps:
                 break
             state, metrics = step_fn(state, batch)
-            rate = meter.tick(cfg.batch_size)
+            rate = meter.tick(items)
             if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
                 loss = float(metrics["loss"])
                 losses.append(loss)

@@ -7,12 +7,17 @@ hand-scheduled TPU kernels below the XLA tier:
 - :mod:`mpit_tpu.ops.ring_allreduce` — ring reduce-scatter + all-gather
   over ICI via double-buffered ``make_async_remote_copy`` (the
   ``MPI_Allreduce`` hot path, SURVEY.md §4.3; the "allreduce GB/s" metric).
+- :mod:`mpit_tpu.ops.flash_attention` — fused blockwise causal attention
+  (online softmax; never materializes the [T, T] score matrix) with a
+  Flash-2 custom-VJP backward, the GPT-2 inner kernel and the per-shard
+  block under ring attention.
 
-Every kernel has an ``interpret`` path (pltpu TPU interpret mode) so its
-semaphore/DMA discipline is testable on the CPU fake mesh (SURVEY.md §6
-"race detection" row), and an XLA-collective fallback for non-TPU backends.
+Every kernel has an ``interpret`` path so its semantics are testable on
+the CPU fake mesh (SURVEY.md §6 "race detection" row), and an XLA
+fallback for non-TPU backends.
 """
 
+from mpit_tpu.ops.flash_attention import flash_attention, reference_attention
 from mpit_tpu.ops.ring_allreduce import ring_allreduce
 
-__all__ = ["ring_allreduce"]
+__all__ = ["flash_attention", "reference_attention", "ring_allreduce"]

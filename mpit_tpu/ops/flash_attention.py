@@ -1,0 +1,408 @@
+"""Pallas flash attention — fused blockwise causal attention.
+
+Not a reference capability (Torch7-era, pre-transformer; SURVEY.md §3.3):
+this kernel exists for the GPT-2 stretch config (BASELINE.json #5) and as
+the per-shard inner kernel under context parallelism
+(:mod:`mpit_tpu.parallel.ring_attention`).
+
+TPU-first design:
+
+- **Never materializes the [T, T] score matrix.** The forward pass
+  processes one ``block_q`` query tile per grid step and streams key/value
+  tiles through a ``fori_loop``, maintaining the online-softmax running
+  max/denominator/accumulator as loop carries in registers/VMEM — HBM
+  traffic is O(T·D), not O(T²).
+- **MXU-shaped**: all matmuls are [block_q, D] × [D, block_k] tiles with
+  float32 accumulation (``preferred_element_type``), bf16-friendly inputs.
+- **Causal block skipping**: the k-loop upper bound is derived from the
+  query tile index, so fully-masked key tiles are never visited (~2×
+  speedup at long T); the diagonal tile applies the triangular mask.
+- **Trainable**: ``jax.custom_vjp`` with the Flash-2 backward — the
+  forward saves only the per-row logsumexp; the backward recomputes score
+  tiles blockwise in two kernels (dq; dk/dv) using the precomputed
+  ``delta = rowsum(dO ⊙ O)``.
+
+Layout contract: public API takes ``[B, T, H, D]`` (the sequence-major,
+head-split layout of :mod:`mpit_tpu.models.gpt2` and the parallel layers).
+On non-TPU backends the same math runs as a plain-XLA fallback (identical
+semantics, used for parity tests and the CPU fake mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # large-but-finite: -inf breaks exp-shift when a full row is masked
+
+
+def _use_kernel(interpret: bool | None) -> bool:
+    if interpret is not None:
+        return True
+    return jax.devices()[0].platform == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Reference (XLA) path — also the non-TPU fallback.
+# ---------------------------------------------------------------------------
+
+
+def reference_attention(q, k, v, *, causal: bool = True):
+    """Plain attention in XLA, [B, T, H, D]; the parity oracle."""
+    dh = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(dh)
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel.
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    t = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    if causal:
+        # Last key tile that intersects the triangle for this query tile.
+        n_k = (qi * bq + bq + block_k - 1) // block_k
+    else:
+        n_k = t // block_k
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if causal:
+            q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1)
+        acc_new = alpha[:, None] * acc + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    # Guard fully-masked rows (can't happen for causal with qi covering its
+    # own diagonal, but keeps the kernel total for future mask kinds).
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = lax.broadcast_in_dim(
+        m + jnp.log(l_safe), (lse_ref.shape[1], _LANES), (0,)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (Flash-2: recompute P blockwise from q, k and the saved
+# logsumexp; delta = rowsum(dO ⊙ O) precomputed in XLA).
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k, causal, scale
+):
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    t = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+
+    n_k = (qi * bq + bq + block_k - 1) // block_k if causal else t // block_k
+
+    def body(ki, dq):
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])  # [bq, bk]
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = lax.fori_loop(0, n_k, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q, causal, scale,
+):
+    bk, d = k_ref.shape[1], k_ref.shape[2]
+    t = q_ref.shape[1]
+    ki = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+
+    n_q = t // block_q
+    # First query tile that intersects the triangle for this key tile.
+    q_start = (ki * bk) // block_q if causal else 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0
+            )
+            k_pos = ki * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, d]
+        return dk_new, dv_new
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = lax.fori_loop(q_start, n_q, body, (z, z))
+    # dL/dk = scale · dsᵀ·q_raw = dsᵀ·q_scaled — q above is already scaled,
+    # so no further factor here.
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing over [BH, T, D].
+# ---------------------------------------------------------------------------
+
+
+def _specs(block_rows: int, d: int):
+    return pl.BlockSpec(
+        (1, block_rows, d), lambda bh, i: (bh, i, 0), memory_space=pltpu.VMEM
+    )
+
+
+# Per-row scalars (logsumexp, delta) carry a broadcast 128-lane minor dim so
+# their blocks satisfy the TPU (8, 128) tiling rule (the in-tree flash
+# kernels use the same trick; MIN_BLOCK_SIZE=128).
+_LANES = 128
+
+
+def _row_spec(block_rows: int):
+    return pl.BlockSpec(
+        (1, block_rows, _LANES), lambda bh, i: (bh, i, 0), memory_space=pltpu.VMEM
+    )
+
+
+def _vma(x):
+    # Inside a VMA-checked shard_map, pallas_call out_shapes must declare
+    # how outputs vary across mesh axes; mirror the query operand's vma.
+    return getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+
+
+def _fwd_3d(q, k, v, *, causal, block_q, block_k, interpret):
+    bh, t, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    grid = (bh, t // block_q)
+    kern = functools.partial(
+        _fwd_kernel, block_k=block_k, causal=causal, scale=scale
+    )
+    o, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            _specs(block_q, d),
+            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[_specs(block_q, d), _row_spec(block_q)],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=_vma(q)),
+            jax.ShapeDtypeStruct((bh, t, _LANES), jnp.float32, vma=_vma(q)),
+        ],
+        interpret=bool(interpret),
+    )(q, k, v)
+    return o, lse
+
+
+def _bwd_3d(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
+    bh, t, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, t, _LANES))
+
+    full = lambda: pl.BlockSpec(
+        (1, t, d), lambda bh, i: (bh, 0, 0), memory_space=pltpu.VMEM
+    )
+    full_row = lambda: pl.BlockSpec(
+        (1, t, _LANES), lambda bh, i: (bh, 0, 0), memory_space=pltpu.VMEM
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale
+        ),
+        grid=(bh, t // block_q),
+        in_specs=[
+            _specs(block_q, d),  # q tile
+            full(),  # k
+            full(),  # v
+            _specs(block_q, d),  # do tile
+            _row_spec(block_q),  # lse tile
+            _row_spec(block_q),  # delta tile
+        ],
+        out_specs=_specs(block_q, d),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=_vma(q)),
+        interpret=bool(interpret),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale
+        ),
+        grid=(bh, t // block_k),
+        in_specs=[
+            full(),  # q
+            _specs(block_k, d),  # k tile
+            _specs(block_k, d),  # v tile
+            full(),  # do
+            full_row(),  # lse
+            full_row(),  # delta
+        ],
+        out_specs=[_specs(block_k, d), _specs(block_k, d)],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype, vma=_vma(q)),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype, vma=_vma(q)),
+        ],
+        interpret=bool(interpret),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP, [B, T, H, D].
+# ---------------------------------------------------------------------------
+
+
+def _to3d(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from3d(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    o3, lse = _fwd_3d(
+        _to3d(q), _to3d(k), _to3d(v),
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    out = _from3d(o3, b, h)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    b, t, h, d = q.shape
+    dq3, dk3, dv3 = _bwd_3d(
+        _to3d(q), _to3d(k), _to3d(v), _to3d(out), lse, _to3d(g),
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return _from3d(dq3, b, h), _from3d(dk3, b, h), _from3d(dv3, b, h)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> Any:
+    """Fused causal attention over ``[B, T, H, D]`` tensors.
+
+    Drop-in for :func:`mpit_tpu.models.gpt2.default_attention` (plug in as
+    ``GPT2Config.attention_fn``). ``T`` must be a multiple of the block
+    sizes (pad upstream or pick smaller blocks — ``block_q``/``block_k``
+    are clamped to ``T``).
+
+    ``interpret``: ``None`` = run the Pallas kernel on TPU, plain-XLA
+    fallback elsewhere; ``True`` = force the kernel through the Pallas
+    interpreter (CPU-mesh testing); ``False`` = force the kernel compiled.
+    """
+    t = q.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if not _use_kernel(interpret):
+        return reference_attention(q, k, v, causal=causal)
+    if t % block_q or t % block_k:
+        raise ValueError(
+            f"seq len {t} must be divisible by block_q={block_q}, block_k={block_k}"
+        )
+    if interpret is None:
+        interpret = False
+    return _flash(q, k, v, causal, block_q, block_k, interpret)

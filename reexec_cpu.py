@@ -63,9 +63,13 @@ def reexec_onto_cpu_mesh_if_needed() -> None:
 
 # Auto-run only when pytest is actually driving this process (the
 # ``-p reexec_cpu`` early-plugin path: argv[0] is the pytest console script
-# or pytest/__main__.py under ``python -m pytest``). Checking for pytest in
-# sys.modules is NOT enough — any program that merely imported pytest would
-# be silently exec'd into a test run when it imports this module (e.g.
-# ``__graft_entry__`` importing :func:`cpu_mesh_env` at runtime).
-if "pytest" in sys.argv[0]:
+# or pytest's __main__.py under ``python -m pytest``). Checking for pytest
+# in sys.modules is NOT enough — any program that merely imported pytest
+# would be silently exec'd into a test run when it imports this module —
+# and a bare substring match on the path would hijack unrelated scripts
+# that merely live under a pytest-named directory.
+_argv0 = sys.argv[0]
+if os.path.basename(_argv0).startswith(("pytest", "py.test")) or _argv0.endswith(
+    os.path.join("pytest", "__main__.py")
+):
     reexec_onto_cpu_mesh_if_needed()
