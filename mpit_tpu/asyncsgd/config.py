@@ -44,6 +44,7 @@ class TrainConfig:
     sync_every: int = 1  # parity mode: client steps between server exchanges
     nranks: int = 2  # parity mode: 1 pserver + (nranks-1) pclients
     mesh: str = ""  # SPMD mesh, e.g. "data=4,model=2"; "" = all-data
+    native: bool = False  # C++ data-pipeline core (falls back if unbuilt)
     log_every: int = 50
     ckpt_dir: str = ""  # orbax checkpoint directory ("" = no checkpoints)
     ckpt_every: int = 0

@@ -86,7 +86,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
 
     tx = goo_adam(cfg.lr, weight_decay=cfg.weight_decay)
     mesh_shape = cfg.mesh_shape()
-    batches = dataset.batches(cfg.batch_size, cfg.seq_len)
+    batches = runner.make_stream(cfg, dataset, cfg.seq_len)
 
     if not mesh_shape or "model" not in mesh_shape:
         # shard_map tier: plain sync DP + ZeRO-1 via the common runner

@@ -57,9 +57,10 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             "accuracy": runner.accuracy(logits, batch["label"]),
         }
 
+    stream = runner.make_stream(cfg, dataset)
     return runner.run_spmd(
         cfg,
-        dataset.batches(cfg.batch_size),
+        stream,
         loss_fn,
         init_params,
         eval_fn=eval_fn,

@@ -48,6 +48,15 @@ def accuracy(logits, labels):
     return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
 
 
+def make_stream(cfg: TrainConfig, dataset, *args):
+    """The workload scripts' input stream: native C++ core when
+    ``cfg.native`` (with internal fallback), else the Python generator.
+    Extra ``args`` are forwarded (e.g. ``seq_len`` for LM datasets)."""
+    if cfg.native:
+        return dataset.native_batches(cfg.batch_size, *args)
+    return dataset.batches(cfg.batch_size, *args)
+
+
 def build_tx(cfg: TrainConfig, *, axis: str | None = None):
     """The goo transformation for a config (Downpour-SGD or EASGD chain)."""
     base = gopt.goo(

@@ -68,6 +68,13 @@ class Prefetcher:
                 for batch in it:
                     if self._stop.is_set():
                         return
+                    # Contract: batches must be OWNED buffers. device_put's
+                    # host-side read has no completion signal (even
+                    # block_until_ready can return before the transfer
+                    # thread reads the buffer), so a source that recycles
+                    # yielded memory (e.g. the native slot ring with
+                    # copy=False) cannot be made safe here — which is why
+                    # the native loader copies at its boundary by default.
                     self._queue.put(shard_batch(world, batch, axis=axis))
             except BaseException as e:  # surfaced on next __next__
                 self._exc = e

@@ -1,0 +1,251 @@
+"""ctypes bindings for the native (C++) data-pipeline core.
+
+The reference's native stratum marshals raw Torch tensor pointers across
+the Lua/C/MPI boundary (SURVEY.md §2 L0, §3.1 C1); this module is the
+framework's host-side counterpart: batch production runs in C++ worker
+threads (``mpit_tpu/native/data_loader.cpp``) that overlap training
+without the GIL, handing buffers across the boundary through a slot ring.
+By default each batch is copied out of its slot at the boundary (one
+memcpy — ``jax.device_put`` gives no host-buffer completion signal, so
+recycling a slot under a pending transfer would corrupt batches; see
+``_SlotIterator``); ``copy=False`` gives true zero-copy views for
+consumers that fully read each batch before advancing.
+
+Build: compiled on first use via the in-tree Makefile (``g++`` is part of
+the environment; SURVEY.md §8.1). If the toolchain or build fails,
+importers fall back to the pure-Python generators in
+:mod:`mpit_tpu.data.synthetic` — same shapes, same learnable structure.
+Set ``MPIT_NATIVE=0`` to force the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Iterator
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libmpit_data.so"
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_BUILD_ERROR: str | None = None
+
+
+def _load() -> ctypes.CDLL | None:
+    """Build (once) and load the native library; None if unavailable."""
+    global _LIB, _BUILD_ERROR
+    if os.environ.get("MPIT_NATIVE", "1") == "0":
+        return None
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _BUILD_ERROR is not None:
+            return None
+        if not _LIB_PATH.exists():
+            try:
+                subprocess.run(
+                    ["make", "-C", str(_NATIVE_DIR)],
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                )
+            except (subprocess.CalledProcessError, FileNotFoundError) as e:
+                _BUILD_ERROR = getattr(e, "stderr", str(e)) or str(e)
+                return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+        except OSError as e:
+            _BUILD_ERROR = str(e)
+            return None
+        _declare(lib)
+        _LIB = lib
+        return lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.mpit_cls_create.restype = c.c_void_p
+    lib.mpit_cls_create.argtypes = [
+        c.POINTER(c.c_float), c.c_int, c.c_int64, c.c_float, c.c_uint64,
+        c.c_int, c.c_int, c.c_int,
+    ]
+    lib.mpit_cls_image_ptr.restype = c.POINTER(c.c_float)
+    lib.mpit_cls_image_ptr.argtypes = [c.c_void_p, c.c_int]
+    lib.mpit_cls_label_ptr.restype = c.POINTER(c.c_int32)
+    lib.mpit_cls_label_ptr.argtypes = [c.c_void_p, c.c_int]
+    lib.mpit_cls_next_slot.restype = c.c_int
+    lib.mpit_cls_next_slot.argtypes = [c.c_void_p]
+    lib.mpit_cls_release_slot.argtypes = [c.c_void_p, c.c_int]
+    lib.mpit_cls_destroy.argtypes = [c.c_void_p]
+
+    lib.mpit_lm_create.restype = c.c_void_p
+    lib.mpit_lm_create.argtypes = [
+        c.POINTER(c.c_int32), c.c_int, c.c_int, c.c_int, c.c_uint64,
+        c.c_int, c.c_int, c.c_int,
+    ]
+    lib.mpit_lm_tokens_ptr.restype = c.POINTER(c.c_int32)
+    lib.mpit_lm_tokens_ptr.argtypes = [c.c_void_p, c.c_int]
+    lib.mpit_lm_next_slot.restype = c.c_int
+    lib.mpit_lm_next_slot.argtypes = [c.c_void_p]
+    lib.mpit_lm_release_slot.argtypes = [c.c_void_p, c.c_int]
+    lib.mpit_lm_destroy.argtypes = [c.c_void_p]
+
+
+def available() -> bool:
+    """Whether the native core can be (or was) built and loaded."""
+    return _load() is not None
+
+
+def build_error() -> str | None:
+    """The captured build/load failure, for diagnostics."""
+    _load()
+    return _BUILD_ERROR
+
+
+class _SlotIterator:
+    """Slot-ring consumption: blocking next, explicit lifecycle.
+
+    ``copy=True`` (default) hands out an owned numpy copy of each slot and
+    releases the slot immediately — safe for any consumer, including
+    ``jax.device_put``, whose host-side read has NO completion signal
+    (``block_until_ready`` can return before the transfer thread has read
+    the buffer; observed as batch corruption on the CPU backend when a
+    recycled slot was overwritten mid-transfer). The C++ win is the
+    native-threaded *generation*; one memcpy per batch is noise next to it.
+
+    ``copy=False`` yields zero-copy views valid only until the next
+    ``__next__`` call — for consumers that fully read the batch (into
+    their own memory) before advancing.
+    """
+
+    def __init__(self, lib, handle, next_fn, release_fn, destroy_fn, views, copy):
+        self._lib = lib
+        self._h = handle
+        self._next = next_fn
+        self._release = release_fn
+        self._destroy = destroy_fn
+        self._views = views  # slot -> batch dict of numpy views
+        self._copy = copy
+        self._held: int | None = None
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        if self._held is not None:
+            self._release(self._h, self._held)
+            self._held = None
+        slot = self._next(self._h)
+        if slot < 0:
+            raise StopIteration
+        if self._copy:
+            batch = {k: np.array(v) for k, v in self._views[slot].items()}
+            self._release(self._h, slot)
+            return batch
+        self._held = slot
+        return self._views[slot]
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            if self._held is not None:
+                self._release(self._h, self._held)
+                self._held = None
+            self._destroy(self._h)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # best-effort; explicit close preferred
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def classification_stream(
+    prototypes: np.ndarray,
+    *,
+    noise: float,
+    batch_size: int,
+    seed: int = 0,
+    depth: int = 4,
+    threads: int = 2,
+    copy: bool = True,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Native prototype+noise stream: ``{"image", "label"}`` batches.
+
+    ``prototypes``: float32 ``[num_classes, *sample_shape]``. Raises
+    ``RuntimeError`` if the native core is unavailable (callers that want
+    graceful degradation check :func:`available` first).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native data core unavailable: {_BUILD_ERROR}")
+    protos = np.ascontiguousarray(prototypes, np.float32)
+    num_classes = protos.shape[0]
+    sample_shape = protos.shape[1:]
+    elems = int(np.prod(sample_shape))
+    handle = lib.mpit_cls_create(
+        protos.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        num_classes, elems, float(noise), seed, batch_size, depth, threads,
+    )
+    views = {}
+    for s in range(depth):
+        img = np.ctypeslib.as_array(
+            lib.mpit_cls_image_ptr(handle, s), shape=(batch_size, *sample_shape)
+        )
+        lab = np.ctypeslib.as_array(
+            lib.mpit_cls_label_ptr(handle, s), shape=(batch_size,)
+        )
+        views[s] = {"image": img, "label": lab}
+    return _SlotIterator(
+        lib, handle, lib.mpit_cls_next_slot, lib.mpit_cls_release_slot,
+        lib.mpit_cls_destroy, views, copy,
+    )
+
+
+def lm_stream(
+    successors: np.ndarray,
+    *,
+    seq_len: int,
+    batch_size: int,
+    seed: int = 0,
+    depth: int = 4,
+    threads: int = 2,
+    copy: bool = True,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Native bigram-walk token stream: ``{"tokens": [B, L+1]}`` batches."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native data core unavailable: {_BUILD_ERROR}")
+    table = np.ascontiguousarray(successors, np.int32)
+    vocab, branching = table.shape
+    handle = lib.mpit_lm_create(
+        table.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vocab, branching, seq_len, seed, batch_size, depth, threads,
+    )
+    views = {
+        s: {
+            "tokens": np.ctypeslib.as_array(
+                lib.mpit_lm_tokens_ptr(handle, s),
+                shape=(batch_size, seq_len + 1),
+            )
+        }
+        for s in range(depth)
+    }
+    return _SlotIterator(
+        lib, handle, lib.mpit_lm_next_slot, lib.mpit_lm_release_slot,
+        lib.mpit_lm_destroy, views, copy,
+    )

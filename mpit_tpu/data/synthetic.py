@@ -52,6 +52,24 @@ class SyntheticClassification:
     def eval_batch(self, batch_size: int, *, seed: int = 10_000):
         return next(self.batches(batch_size, seed=seed))
 
+    def native_batches(
+        self, batch_size: int, *, seed: int | None = None, threads: int = 2
+    ):
+        """The same stream produced by the C++ core (zero-copy slot views);
+        falls back to :meth:`batches` when the native build is unavailable.
+        Same distribution/learnable structure, different RNG stream."""
+        from mpit_tpu.data import native
+
+        if not native.available():
+            return self.batches(batch_size, seed=seed)
+        return native.classification_stream(
+            self.prototypes,
+            noise=self.noise,
+            batch_size=batch_size,
+            seed=self.seed + 1 if seed is None else seed,
+            threads=threads,
+        )
+
 
 def synthetic_mnist(noise: float = 0.4, seed: int = 0) -> SyntheticClassification:
     """MNIST-shaped stream: 28×28×1, 10 classes (baseline configs #1/#2)."""
@@ -112,3 +130,25 @@ class SyntheticLM:
                 choice = rng.randint(0, self.branching, size=batch_size)
                 toks[:, t + 1] = self.successors[toks[:, t], choice]
             yield {"tokens": toks}
+
+    def native_batches(
+        self,
+        batch_size: int,
+        seq_len: int,
+        *,
+        seed: int | None = None,
+        threads: int = 2,
+    ):
+        """C++-core token stream; falls back to :meth:`batches` when the
+        native build is unavailable."""
+        from mpit_tpu.data import native
+
+        if not native.available():
+            return self.batches(batch_size, seq_len, seed=seed)
+        return native.lm_stream(
+            self.successors,
+            seq_len=seq_len,
+            batch_size=batch_size,
+            seed=self.seed + 1 if seed is None else seed,
+            threads=threads,
+        )

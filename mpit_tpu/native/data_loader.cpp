@@ -1,0 +1,329 @@
+// mpit_tpu native data-pipeline core.
+//
+// The reference's only native stratum is a C binding that hands raw Torch
+// tensor memory across the Lua/MPI boundary (SURVEY.md §2 L0, §3.1 C1).
+// This framework's counterpart on the host side: batch *production* in
+// native threads, handing raw buffer pointers across the C/Python boundary
+// (zero-copy numpy views; see mpit_tpu/data/native.py).
+//
+// Model: a ring of pre-allocated batch slots. `threads` producer workers
+// each claim a free slot and a global batch ticket n, fill the slot with
+// batch n (classification: label sampling + prototype gather + Gaussian
+// noise; LM: bigram-table random walks), and push it onto the ready map.
+// The consumer pops slots strictly in ticket order (`*_next_slot`,
+// blocking) and returns them (`*_release_slot`) once consumed — so
+// generation of batch N+depth overlaps training on batch N without
+// holding the GIL.
+//
+// Determinism: batch n's content is a pure function of (seed, n) — each
+// ticket seeds its own splitmix64→xoshiro256++ stream — and delivery is
+// in ticket order, so the stream is bit-identical across runs AND across
+// thread counts. (At most `depth` tickets are outstanding, so ordered
+// delivery cannot deadlock: the missing ticket is always being filled.)
+// Not bit-identical to the numpy reference path (different generator);
+// the parity tests check distributional properties, not bytes.
+
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <utility>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Xoshiro {
+  uint64_t s[4];
+
+  static uint64_t splitmix64(uint64_t& x) {
+    x += 0x9E3779B97f4A7C15ull;
+    uint64_t z = x;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+  }
+
+  explicit Xoshiro(uint64_t seed) {
+    for (auto& w : s) w = splitmix64(seed);
+  }
+
+  static uint64_t rotl(uint64_t x, int k) { return (x << k) | (x >> (64 - k)); }
+
+  uint64_t next() {
+    const uint64_t result = rotl(s[0] + s[3], 23) + s[0];
+    const uint64_t t = s[1] << 17;
+    s[2] ^= s[0];
+    s[3] ^= s[1];
+    s[1] ^= s[2];
+    s[0] ^= s[3];
+    s[2] ^= t;
+    s[3] = rotl(s[3], 45);
+    return result;
+  }
+
+  // Uniform in [0, 1).
+  double uniform() { return (next() >> 11) * 0x1.0p-53; }
+
+  uint32_t below(uint32_t n) { return static_cast<uint32_t>(next() % n); }
+
+  // Standard normal: Box–Muller, consuming both outputs (the spare halves
+  // the log/sqrt/trig cost — this is the noise hot loop).
+  bool has_spare = false;
+  float spare = 0.0f;
+
+  float normal() {
+    if (has_spare) {
+      has_spare = false;
+      return spare;
+    }
+    double u1 = uniform(), u2 = uniform();
+    if (u1 < 1e-300) u1 = 1e-300;
+    const double r = std::sqrt(-2.0 * std::log(u1));
+    const double a = 2.0 * M_PI * u2;
+    spare = static_cast<float>(r * std::sin(a));
+    has_spare = true;
+    return static_cast<float>(r * std::cos(a));
+  }
+};
+
+// A multi-producer slot ring with ticketed, in-order delivery.
+class SlotRing {
+ public:
+  SlotRing(int depth) : depth_(depth) {
+    for (int i = 0; i < depth; ++i) free_.push_back(i);
+  }
+
+  // Producer side: claim a free slot and the next batch ticket
+  // (or ticket == UINT64_MAX on shutdown).
+  std::pair<int, uint64_t> claim_free() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_free_.wait(lk, [&] { return stop_ || !free_.empty(); });
+    if (stop_) return {-1, UINT64_MAX};
+    int s = free_.front();
+    free_.pop_front();
+    return {s, next_ticket_++};
+  }
+
+  void push_ready(int slot, uint64_t ticket) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      ready_[ticket] = slot;
+    }
+    cv_ready_.notify_all();
+  }
+
+  // Consumer side: slots come out in ticket order regardless of which
+  // worker finished first.
+  int pop_ready() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_ready_.wait(lk, [&] { return stop_ || ready_.count(next_deliver_); });
+    auto it = ready_.find(next_deliver_);
+    if (it == ready_.end()) return -1;  // stopped
+    int s = it->second;
+    ready_.erase(it);
+    ++next_deliver_;
+    return s;
+  }
+
+  void release(int slot) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      free_.push_back(slot);
+    }
+    cv_free_.notify_one();
+  }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_free_.notify_all();
+    cv_ready_.notify_all();
+  }
+
+ private:
+  const int depth_;
+  std::mutex mu_;
+  std::condition_variable cv_free_, cv_ready_;
+  std::deque<int> free_;
+  std::map<uint64_t, int> ready_;
+  uint64_t next_ticket_ = 0;
+  uint64_t next_deliver_ = 0;
+  bool stop_ = false;
+};
+
+// Per-ticket RNG: batch n's stream depends only on (seed, n).
+inline Xoshiro ticket_rng(uint64_t seed, uint64_t ticket) {
+  uint64_t x = seed ^ (0x9E3779B97f4A7C15ull * (ticket + 1));
+  return Xoshiro(Xoshiro::splitmix64(x));
+}
+
+// ---------------------------------------------------------------------------
+// Classification loader: images = prototypes[label] + noise * N(0, 1).
+// ---------------------------------------------------------------------------
+
+struct ClsLoader {
+  std::vector<float> protos;  // [num_classes, sample_elems] (owned copy)
+  int64_t sample_elems;
+  int num_classes;
+  float noise;
+  uint64_t seed;
+  int batch;
+  SlotRing ring;
+  std::vector<std::vector<float>> images;  // per slot: [batch * sample_elems]
+  std::vector<std::vector<int32_t>> labels;  // per slot: [batch]
+  std::vector<std::thread> workers;
+
+  ClsLoader(const float* p, int nc, int64_t elems, float nz, uint64_t sd,
+            int b, int depth, int nthreads)
+      : protos(p, p + nc * elems),
+        sample_elems(elems),
+        num_classes(nc),
+        noise(nz),
+        seed(sd),
+        batch(b),
+        ring(depth),
+        images(depth),
+        labels(depth) {
+    for (int i = 0; i < depth; ++i) {
+      images[i].resize(static_cast<size_t>(batch) * elems);
+      labels[i].resize(batch);
+    }
+    for (int w = 0; w < nthreads; ++w) {
+      workers.emplace_back([this] { run(); });
+    }
+  }
+
+  void run() {
+    while (true) {
+      auto [slot, ticket] = ring.claim_free();
+      if (slot < 0) return;
+      Xoshiro rng = ticket_rng(seed, ticket);
+      float* img = images[slot].data();
+      int32_t* lab = labels[slot].data();
+      for (int i = 0; i < batch; ++i) {
+        int32_t c = static_cast<int32_t>(rng.below(num_classes));
+        lab[i] = c;
+        const float* proto = protos.data() + static_cast<size_t>(c) * sample_elems;
+        float* dst = img + static_cast<size_t>(i) * sample_elems;
+        for (int64_t e = 0; e < sample_elems; ++e) {
+          dst[e] = proto[e] + noise * rng.normal();
+        }
+      }
+      ring.push_ready(slot, ticket);
+    }
+  }
+
+  ~ClsLoader() {
+    ring.stop();
+    for (auto& t : workers) t.join();
+  }
+};
+
+// ---------------------------------------------------------------------------
+// LM loader: token random walks over a [vocab, branching] successor table.
+// ---------------------------------------------------------------------------
+
+struct LmLoader {
+  std::vector<int32_t> table;  // [vocab, branching]
+  int vocab, branching, seq_len;
+  uint64_t seed;
+  int batch;
+  SlotRing ring;
+  std::vector<std::vector<int32_t>> tokens;  // per slot: [batch, seq_len + 1]
+  std::vector<std::thread> workers;
+
+  LmLoader(const int32_t* t, int v, int br, int sl, uint64_t sd, int b,
+           int depth, int nthreads)
+      : table(t, t + static_cast<size_t>(v) * br),
+        vocab(v),
+        branching(br),
+        seq_len(sl),
+        seed(sd),
+        batch(b),
+        ring(depth),
+        tokens(depth) {
+    for (int i = 0; i < depth; ++i) {
+      tokens[i].resize(static_cast<size_t>(batch) * (seq_len + 1));
+    }
+    for (int w = 0; w < nthreads; ++w) {
+      workers.emplace_back([this] { run(); });
+    }
+  }
+
+  void run() {
+    while (true) {
+      auto [slot, ticket] = ring.claim_free();
+      if (slot < 0) return;
+      Xoshiro rng = ticket_rng(seed, ticket);
+      int32_t* out = tokens[slot].data();
+      for (int i = 0; i < batch; ++i) {
+        int32_t* row = out + static_cast<size_t>(i) * (seq_len + 1);
+        row[0] = static_cast<int32_t>(rng.below(vocab));
+        for (int tpos = 0; tpos < seq_len; ++tpos) {
+          const int32_t* succ = table.data() + static_cast<size_t>(row[tpos]) * branching;
+          row[tpos + 1] = succ[rng.below(branching)];
+        }
+      }
+      ring.push_ready(slot, ticket);
+    }
+  }
+
+  ~LmLoader() {
+    ring.stop();
+    for (auto& t : workers) t.join();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- classification -------------------------------------------------------
+
+void* mpit_cls_create(const float* protos, int num_classes, int64_t sample_elems,
+                      float noise, uint64_t seed, int batch, int depth,
+                      int threads) {
+  return new ClsLoader(protos, num_classes, sample_elems, noise, seed, batch,
+                       depth, threads);
+}
+
+// Buffer addresses for slot `i` (stable for the loader's lifetime), so the
+// caller can wrap them as zero-copy array views once.
+float* mpit_cls_image_ptr(void* h, int slot) {
+  return static_cast<ClsLoader*>(h)->images[slot].data();
+}
+int32_t* mpit_cls_label_ptr(void* h, int slot) {
+  return static_cast<ClsLoader*>(h)->labels[slot].data();
+}
+
+int mpit_cls_next_slot(void* h) { return static_cast<ClsLoader*>(h)->ring.pop_ready(); }
+void mpit_cls_release_slot(void* h, int slot) {
+  static_cast<ClsLoader*>(h)->ring.release(slot);
+}
+void mpit_cls_destroy(void* h) { delete static_cast<ClsLoader*>(h); }
+
+// ---- language modeling ----------------------------------------------------
+
+void* mpit_lm_create(const int32_t* table, int vocab, int branching, int seq_len,
+                     uint64_t seed, int batch, int depth, int threads) {
+  return new LmLoader(table, vocab, branching, seq_len, seed, batch, depth,
+                      threads);
+}
+
+int32_t* mpit_lm_tokens_ptr(void* h, int slot) {
+  return static_cast<LmLoader*>(h)->tokens[slot].data();
+}
+
+int mpit_lm_next_slot(void* h) { return static_cast<LmLoader*>(h)->ring.pop_ready(); }
+void mpit_lm_release_slot(void* h, int slot) {
+  static_cast<LmLoader*>(h)->ring.release(slot);
+}
+void mpit_lm_destroy(void* h) { delete static_cast<LmLoader*>(h); }
+
+}  // extern "C"
