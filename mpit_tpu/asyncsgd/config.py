@@ -46,6 +46,7 @@ class TrainConfig:
     mesh: str = ""  # SPMD mesh, e.g. "data=4,model=2"; "" = all-data
     native: bool = False  # C++ data-pipeline core (falls back if unbuilt)
     log_every: int = 50
+    profile_dir: str = ""  # capture a jax.profiler trace of steps 2..5
     ckpt_dir: str = ""  # orbax checkpoint directory ("" = no checkpoints)
     ckpt_every: int = 0
     eval_batch: int = 256

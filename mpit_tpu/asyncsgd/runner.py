@@ -28,6 +28,7 @@ import optax
 import mpit_tpu
 from mpit_tpu import opt as gopt
 from mpit_tpu.asyncsgd import actors
+from mpit_tpu.utils import profiling
 from mpit_tpu.asyncsgd.config import TrainConfig
 from mpit_tpu.data import Prefetcher
 from mpit_tpu.train import (
@@ -126,20 +127,48 @@ def run_spmd(
     for _ in range(start_step):
         next(batches)
     items = items_per_batch or cfg.batch_size
-    with Prefetcher(world, batches, axis=axis) as stream:
-        for i, batch in enumerate(stream):
-            step = start_step + i
-            if step >= cfg.steps:
-                break
-            state, metrics = step_fn(state, batch)
-            rate = meter.tick(items)
-            if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
-                loss = float(metrics["loss"])
-                losses.append(loss)
-                logger.log(step + 1, {**{k: float(v) for k, v in metrics.items()},
-                                      "items_per_sec": rate})
-            if ckpt and cfg.ckpt_every and (step + 1) % cfg.ckpt_every == 0:
-                ckpt.save(step + 1, state)
+
+    # Per-step ICI traffic model (SURVEY.md §6 metrics row), logged once.
+    # Gradient sync rides the data axis only, so size by that axis (a
+    # multi-axis mesh's model/pipe dims don't carry grad allreduce).
+    comm = profiling.CommModel(params, world.axis_size(axis), zero1=cfg.zero1)
+    logger.log(start_step, {"comm_" + k: v for k, v in comm.summary().items()})
+
+    # Trace a small window past compile/warmup — steps 2..5 of this run,
+    # clamped into range so short runs still capture something.
+    prof_window = None
+    if cfg.profile_dir and cfg.steps > start_step:
+        last = cfg.steps - 1
+        prof_window = (min(start_step + 2, last), min(start_step + 5, last))
+    tracing = False
+    try:
+        with Prefetcher(world, batches, axis=axis) as stream:
+            for i, batch in enumerate(stream):
+                step = start_step + i
+                if step >= cfg.steps:
+                    break
+                if prof_window and step == prof_window[0]:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    tracing = True
+                state, metrics = step_fn(state, batch)
+                if tracing and step == prof_window[1]:
+                    float(metrics["loss"])  # host fetch: trace covers real work
+                    jax.profiler.stop_trace()
+                    tracing = False
+                rate = meter.tick(items)
+                if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+                    loss = float(metrics["loss"])
+                    losses.append(loss)
+                    logger.log(
+                        step + 1,
+                        {**{k: float(v) for k, v in metrics.items()},
+                         "items_per_sec": rate},
+                    )
+                if ckpt and cfg.ckpt_every and (step + 1) % cfg.ckpt_every == 0:
+                    ckpt.save(step + 1, state)
+    finally:
+        if tracing:  # run ended (or raised) inside the window
+            jax.profiler.stop_trace()
     if ckpt:
         ckpt.wait()
 

@@ -1,0 +1,33 @@
+"""mpit_tpu.utils — observability and accounting utilities.
+
+Where the reference's observability is per-rank prints and ad-hoc wall
+timers in its Lua scripts (SURVEY.md §6), this package provides the
+TPU-native toolkit: profiler traces, blocking step timers, XLA cost
+analysis, roofline estimates, and collective-traffic models.
+"""
+
+from mpit_tpu.utils.profiling import (
+    ChipSpec,
+    CommModel,
+    StepTimer,
+    TPU_V5E,
+    allreduce_gbps,
+    collective_bytes,
+    compiled_cost,
+    roofline,
+    trace,
+    tree_bytes,
+)
+
+__all__ = [
+    "ChipSpec",
+    "CommModel",
+    "StepTimer",
+    "TPU_V5E",
+    "allreduce_gbps",
+    "collective_bytes",
+    "compiled_cost",
+    "roofline",
+    "trace",
+    "tree_bytes",
+]

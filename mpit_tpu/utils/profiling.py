@@ -1,0 +1,233 @@
+"""Tracing, timing, and cost accounting (SURVEY.md §6 "Tracing/profiling").
+
+The reference's observability is ad-hoc wall timers and prints in its
+training scripts (SURVEY.md §6). TPU-natively the toolkit is:
+
+- :func:`trace` — ``jax.profiler`` capture (Perfetto/XPlane) around a code
+  region; view with ``xprof``/TensorBoard.
+- :class:`StepTimer` — honest per-step wall timing: ``block=True`` inserts
+  ``block_until_ready`` so async dispatch can't hide device time.
+- :func:`compiled_cost` — XLA's own FLOP/byte estimates for a jitted
+  function (``.cost_analysis()``), the ground truth for arithmetic
+  intensity.
+- :func:`roofline` — time lower bound from chip peaks (defaults: TPU v5e);
+  labels a workload compute- vs bandwidth-bound. Multi-chip numbers in
+  this 1-chip environment are *estimates* and labeled as such
+  (SURVEY.md §8.4.5 "honest perf accounting").
+- :func:`collective_bytes` — wire-traffic model for the mpiT-analogue
+  collectives (ring allreduce moves 2·(P−1)/P·N bytes per chip, etc.),
+  the denominator of the BASELINE "allreduce GB/s" metric.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Any, Callable, Iterator
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a profiler trace of the enclosed region into ``log_dir``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock step timing with device-completion fencing.
+
+    ``block=True`` (default) closes each tick on a **host-value fetch** of
+    a scalar derived from the result passed to :meth:`tick` — without a
+    fence, async dispatch makes steps look free and the *last* timed
+    region absorbs the whole pipeline. A host fetch (not
+    ``block_until_ready``) is used deliberately: on remote-attached TPUs
+    block_until_ready can return before execution completes (bench.py
+    observed orders-of-magnitude inflated throughput from it).
+    """
+
+    def __init__(self, *, block: bool = True):
+        self._block = block
+        self._t0: float | None = None
+        self.times: list[float] = []
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    @staticmethod
+    def _fence(result: Any) -> None:
+        leaves = [l for l in jax.tree.leaves(result) if hasattr(l, "dtype")]
+        if not leaves:
+            return
+        leaf = leaves[0]
+        # Reduce to one scalar on device, fetch it: forces the dependency
+        # chain without gathering a whole array to host.
+        scalar = leaf if getattr(leaf, "ndim", 0) == 0 else leaf.ravel()[0]
+        float(np.asarray(scalar).reshape(()).astype(np.float64))
+
+    def tick(self, result: Any = None) -> float:
+        """Record one step; returns its duration in seconds."""
+        if self._t0 is None:
+            raise RuntimeError("StepTimer.tick() before start()")
+        if self._block and result is not None:
+            self._fence(result)
+        now = time.perf_counter()
+        dt = now - self._t0
+        self.times.append(dt)
+        self._t0 = now
+        return dt
+
+    def summary(self, *, skip_warmup: int = 1) -> dict[str, float]:
+        ts = self.times[skip_warmup:] or self.times
+        arr = np.asarray(ts)
+        return {
+            "steps": len(arr),
+            "mean_s": float(arr.mean()),
+            "p50_s": float(np.percentile(arr, 50)),
+            "p95_s": float(np.percentile(arr, 95)),
+            "total_s": float(arr.sum()),
+        }
+
+
+def compiled_cost(fn: Callable, *args, **kwargs) -> dict[str, float]:
+    """XLA's cost analysis for ``jit(fn)(*args)``: flops, bytes accessed.
+
+    Returns ``{}`` keys absent when the backend doesn't report them.
+    """
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    cost = compiled.cost_analysis() or {}
+    out = {}
+    for key in ("flops", "bytes accessed", "optimal_seconds"):
+        if key in cost:
+            out[key.replace(" ", "_")] = float(cost[key])
+    # Memory footprint of the executable, when reported.
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            out["output_size_bytes"] = float(
+                getattr(mem, "output_size_in_bytes", 0.0)
+            )
+            out["temp_size_bytes"] = float(getattr(mem, "temp_size_in_bytes", 0.0))
+    except Exception:
+        pass
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Peak numbers for a roofline. Defaults: TPU v5e (public figures)."""
+
+    name: str = "tpu-v5e"
+    peak_flops_bf16: float = 197e12  # FLOP/s
+    hbm_bandwidth: float = 819e9  # bytes/s
+    ici_bandwidth: float = 4.5e10  # bytes/s per link direction (3 links/chip)
+
+
+TPU_V5E = ChipSpec()
+
+
+def roofline(
+    flops: float,
+    hbm_bytes: float,
+    *,
+    ici_bytes: float = 0.0,
+    chip: ChipSpec = TPU_V5E,
+) -> dict[str, Any]:
+    """Lower-bound step time from chip peaks; labels the binding resource.
+
+    This is an *estimate* (perfect overlap assumed); on 1-chip
+    environments it is the only honest way to discuss multi-chip scaling
+    (SURVEY.md §8.4.5), and results should be reported as modeled, not
+    measured.
+    """
+    t_compute = flops / chip.peak_flops_bf16
+    t_hbm = hbm_bytes / chip.hbm_bandwidth
+    t_ici = ici_bytes / chip.ici_bandwidth if ici_bytes else 0.0
+    t = max(t_compute, t_hbm, t_ici)
+    bound = {t_compute: "compute", t_hbm: "hbm", t_ici: "ici"}[t]
+    return {
+        "seconds_lower_bound": t,
+        "bound": bound,
+        "arithmetic_intensity": flops / hbm_bytes if hbm_bytes else float("inf"),
+        "chip": chip.name,
+        "modeled": True,  # not a measurement
+    }
+
+
+def tree_bytes(tree: Any) -> int:
+    """Total bytes of a pytree of arrays (host or device)."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(tree)
+        if hasattr(leaf, "dtype")
+    )
+
+
+def collective_bytes(
+    payload_bytes: float, num_devices: int, op: str = "allreduce"
+) -> float:
+    """Per-chip wire bytes for a collective over ``num_devices`` ring.
+
+    Models (bandwidth-optimal ring algorithms, the ones XLA/ICI and the
+    Pallas tier implement):
+
+    - allreduce: 2·(P−1)/P · N   (reduce-scatter + all-gather)
+    - reduce_scatter / all_gather: (P−1)/P · N
+    - broadcast: N (pipelined ring)
+    - alltoall: (P−1)/P · N
+    """
+    p = num_devices
+    if p <= 1:
+        return 0.0
+    n = float(payload_bytes)
+    if op == "allreduce":
+        return 2.0 * (p - 1) / p * n
+    if op in ("reduce_scatter", "all_gather", "alltoall"):
+        return (p - 1) / p * n
+    if op == "broadcast":
+        return n
+    raise ValueError(f"unknown op {op!r}")
+
+
+def allreduce_gbps(
+    payload_bytes: float, num_devices: int, seconds: float
+) -> float:
+    """The BASELINE "allreduce GB/s" metric: algorithm bandwidth
+    (payload / time — the MPI convention), NOT wire bandwidth."""
+    del num_devices  # algorithm bandwidth is payload-relative
+    return payload_bytes / seconds / 1e9
+
+
+class CommModel:
+    """Per-step communication accounting for a training config.
+
+    Static model of what the SPMD step moves over ICI — gradients
+    (allreduce, or reduce-scatter + all-gather under ZeRO-1) — so logs can
+    report comm-bytes alongside measured step time (SURVEY.md §6
+    metrics row).
+    """
+
+    def __init__(self, params, num_devices: int, *, zero1: bool = True):
+        self.param_bytes = tree_bytes(params)
+        self.num_devices = num_devices
+        self.zero1 = zero1
+
+    def grad_sync_bytes(self) -> float:
+        if self.zero1:
+            return collective_bytes(
+                self.param_bytes, self.num_devices, "reduce_scatter"
+            ) + collective_bytes(self.param_bytes, self.num_devices, "all_gather")
+        return collective_bytes(self.param_bytes, self.num_devices, "allreduce")
+
+    def summary(self) -> dict[str, float]:
+        return {
+            "param_bytes": float(self.param_bytes),
+            "grad_sync_bytes_per_step": self.grad_sync_bytes(),
+            "num_devices": self.num_devices,
+        }

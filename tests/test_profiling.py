@@ -1,0 +1,109 @@
+"""Tests for the observability toolkit (mpit_tpu.utils.profiling)."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpit_tpu.utils import (
+    CommModel,
+    StepTimer,
+    allreduce_gbps,
+    collective_bytes,
+    compiled_cost,
+    roofline,
+    tree_bytes,
+)
+
+
+class TestStepTimer:
+    def test_timing_and_summary(self):
+        t = StepTimer(block=False)
+        t.start()
+        for _ in range(5):
+            t.tick()
+        s = t.summary(skip_warmup=1)
+        assert s["steps"] == 4
+        assert s["total_s"] >= 0
+        assert s["p95_s"] >= s["p50_s"] >= 0
+
+    def test_tick_before_start_raises(self):
+        with pytest.raises(RuntimeError):
+            StepTimer().tick()
+
+    def test_block_waits_device_result(self):
+        t = StepTimer(block=True)
+        t.start()
+        x = jax.jit(lambda v: v @ v)(jnp.ones((256, 256)))
+        dt = t.tick(x)
+        assert dt > 0
+        assert np.isfinite(np.asarray(x)).all()  # result materialized
+
+
+class TestCompiledCost:
+    def test_matmul_flops_reported(self):
+        a = jnp.ones((128, 128))
+        cost = compiled_cost(lambda x: x @ x, a)
+        # 2*N^3 MACs; accept any backend-reported positive figure.
+        if "flops" in cost:
+            assert cost["flops"] >= 128 * 128 * 128
+        else:
+            pytest.skip("backend reports no flops")
+
+
+class TestRoofline:
+    def test_compute_vs_bandwidth_bound(self):
+        # Huge flops, tiny bytes → compute-bound; and vice versa.
+        r1 = roofline(1e15, 1e6)
+        assert r1["bound"] == "compute" and r1["modeled"] is True
+        r2 = roofline(1e6, 1e12)
+        assert r2["bound"] == "hbm"
+        r3 = roofline(1e6, 1e6, ici_bytes=1e12)
+        assert r3["bound"] == "ici"
+        assert r1["seconds_lower_bound"] > 0
+
+
+class TestCollectiveModel:
+    def test_ring_formulas(self):
+        n = 1e9
+        assert collective_bytes(n, 1) == 0.0
+        np.testing.assert_allclose(collective_bytes(n, 8), 2 * 7 / 8 * n)
+        np.testing.assert_allclose(
+            collective_bytes(n, 8, "reduce_scatter"), 7 / 8 * n
+        )
+        np.testing.assert_allclose(collective_bytes(n, 8, "broadcast"), n)
+        with pytest.raises(ValueError):
+            collective_bytes(n, 8, "gossip")
+
+    def test_zero1_vs_plain_allreduce_equal_wire_bytes(self):
+        # reduce-scatter + all-gather == allreduce on the wire.
+        params = {"w": jnp.ones((1000, 10)), "b": jnp.ones((10,))}
+        z = CommModel(params, 8, zero1=True).grad_sync_bytes()
+        a = CommModel(params, 8, zero1=False).grad_sync_bytes()
+        np.testing.assert_allclose(z, a)
+
+    def test_tree_bytes(self):
+        params = {"w": jnp.ones((10, 10), jnp.float32), "s": jnp.ones((4,), jnp.bfloat16)}
+        assert tree_bytes(params) == 10 * 10 * 4 + 4 * 2
+
+    def test_allreduce_gbps(self):
+        assert allreduce_gbps(8e9, 8, 2.0) == 4.0
+
+
+class TestTraceIntegration:
+    def test_app_profile_dir_writes_trace(self, tmp_path):
+        from mpit_tpu.asyncsgd import mnist
+
+        out = mnist.main(
+            ["--steps", "8", "--batch-size", "16", "--log-every", "8",
+             "--profile-dir", str(tmp_path / "prof")]
+        )
+        assert out["steps"] == 8
+        produced = []
+        for root, _, files in os.walk(tmp_path / "prof"):
+            produced += [os.path.join(root, f) for f in files]
+        assert produced, "no trace files written"
