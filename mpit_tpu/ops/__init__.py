@@ -17,7 +17,18 @@ the CPU fake mesh (SURVEY.md §6 "race detection" row), and an XLA
 fallback for non-TPU backends.
 """
 
-from mpit_tpu.ops.flash_attention import flash_attention, reference_attention
+from mpit_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_block,
+    merge_attention,
+    reference_attention,
+)
 from mpit_tpu.ops.ring_allreduce import ring_allreduce
 
-__all__ = ["flash_attention", "reference_attention", "ring_allreduce"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_block",
+    "merge_attention",
+    "reference_attention",
+    "ring_allreduce",
+]

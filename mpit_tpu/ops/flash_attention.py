@@ -3,7 +3,7 @@
 Not a reference capability (Torch7-era, pre-transformer; SURVEY.md §3.3):
 this kernel exists for the GPT-2 stretch config (BASELINE.json #5) and as
 the per-shard inner kernel under context parallelism
-(:mod:`mpit_tpu.parallel.ring_attention`).
+(:func:`mpit_tpu.parallel.ring_attention.ring_flash_attention`).
 
 TPU-first design:
 
@@ -15,12 +15,19 @@ TPU-first design:
 - **MXU-shaped**: all matmuls are [block_q, D] × [D, block_k] tiles with
   float32 accumulation (``preferred_element_type``), bf16-friendly inputs.
 - **Causal block skipping**: the k-loop upper bound is derived from the
-  query tile index, so fully-masked key tiles are never visited (~2×
-  speedup at long T); the diagonal tile applies the triangular mask.
+  query tile index (and the global offsets, below), so fully-masked key
+  tiles are never visited; the diagonal tile applies the triangular mask.
+- **Global position offsets**: ``q_offset``/``k_offset`` (traced scalars)
+  shift the causal mask, so the same kernel computes one *block* of a
+  longer sequence — the per-shard compute of ring attention. A key block
+  entirely in this query block's future yields zero output and
+  ``lse = -BIG`` (an exact no-op under the lse-merge).
 - **Trainable**: ``jax.custom_vjp`` with the Flash-2 backward — the
   forward saves only the per-row logsumexp; the backward recomputes score
-  tiles blockwise in two kernels (dq; dk/dv) using the precomputed
-  ``delta = rowsum(dO ⊙ O)``.
+  tiles blockwise in two kernels (dq; dk/dv). The kernel's second output
+  ``lse`` is differentiable too: its cotangent folds into the backward as
+  ``delta → delta − g_lse`` (since ∂lse/∂S = P), which is what makes the
+  ring-attention merge differentiable end-to-end with no extra kernels.
 
 Layout contract: public API takes ``[B, T, H, D]`` (the sequence-major,
 head-split layout of :mod:`mpit_tpu.models.gpt2` and the parallel layers).
@@ -35,11 +42,17 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30  # large-but-finite: -inf breaks exp-shift when a full row is masked
+
+# Per-row scalars (logsumexp, delta) carry a broadcast 128-lane minor dim so
+# their blocks satisfy the TPU (8, 128) tiling rule (the in-tree flash
+# kernels use the same trick; MIN_BLOCK_SIZE=128).
+_LANES = 128
 
 
 def _use_kernel(interpret: bool | None) -> bool:
@@ -55,36 +68,71 @@ def _use_kernel(interpret: bool | None) -> bool:
 
 def reference_attention(q, k, v, *, causal: bool = True):
     """Plain attention in XLA, [B, T, H, D]; the parity oracle."""
+    o, _ = reference_attention_with_lse(q, k, v, causal=causal)
+    return o
+
+
+def reference_attention_with_lse(q, k, v, *, q_offset=0, k_offset=0, causal=True):
+    """XLA attention block returning ``(o [B,T,H,D], lse [B,H,T])``.
+
+    Offset-aware causal masking; fully-masked rows yield ``o = 0`` and
+    ``lse = -BIG`` (the merge-neutral element).
+    """
     dh = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(dh)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(dh).astype(jnp.float32)
     if causal:
         tq, tk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
-        s = jnp.where(mask, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        q_pos = q_offset + lax.iota(jnp.int32, tq)
+        k_pos = k_offset + lax.iota(jnp.int32, tk)
+        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    empty = m <= _NEG_INF / 2
+    p = jnp.where(empty[..., None], 0.0, jnp.exp(s - m[..., None]))
+    l = jnp.sum(p, axis=-1)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.einsum("bhqk,bkhd->bqhd", (p / l_safe[..., None]).astype(q.dtype), v)
+    lse = jnp.where(empty, _NEG_INF, m + jnp.log(l_safe))
+    o = jnp.where(empty.transpose(0, 2, 1)[..., None], 0.0, o).astype(q.dtype)
+    return o, lse
 
 
 # ---------------------------------------------------------------------------
-# Forward kernel.
+# Kernels. Offsets arrive as (1,) int32 SMEM scalars.
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
+def _causal_bounds(qoff, koff, qi, bq, bk, t, *, causal):
+    """Number of key tiles the k-loop must visit (traced)."""
+    n_total = t // bk
+    if not causal:
+        return n_total
+    limit = qoff + qi * bq + bq - koff  # last visible key position + 1
+    return jnp.clip((limit + bk - 1) // bk, 0, n_total)
+
+
+def _mask(s, qoff, koff, qi, bq, ki, bk):
+    q_pos = qoff + qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = koff + ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _fwd_kernel(
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    *, block_k, causal, scale,
+):
     bq, d = q_ref.shape[1], q_ref.shape[2]
     t = k_ref.shape[1]
     qi = pl.program_id(1)
+    qoff, koff = qoff_ref[0], koff_ref[0]
     q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
 
     m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
 
-    if causal:
-        # Last key tile that intersects the triangle for this query tile.
-        n_k = (qi * bq + bq + block_k - 1) // block_k
-    else:
-        n_k = t // block_k
+    n_k = _causal_bounds(qoff, koff, qi, bq, block_k, t, causal=causal)
 
     def body(ki, carry):
         m, l, acc = carry
@@ -95,11 +143,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
             preferred_element_type=jnp.float32,
         )  # [bq, bk]
         if causal:
-            q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_pos = ki * block_k + lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _mask(s, qoff, koff, qi, bq, ki, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -111,33 +155,37 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
         return m_new, l_new, acc_new
 
     m, l, acc = lax.fori_loop(0, n_k, body, (m0, l0, acc0))
-    # Guard fully-masked rows (can't happen for causal with qi covering its
-    # own diagonal, but keeps the kernel total for future mask kinds).
+    # Fully-masked rows (empty k-range under offsets): o = 0, lse = -BIG —
+    # the exact neutral element of the lse-merge.
+    empty = m <= _NEG_INF / 2
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = lax.broadcast_in_dim(
-        m + jnp.log(l_safe), (lse_ref.shape[1], _LANES), (0,)
+    o = jnp.where(empty[:, None], 0.0, acc / l_safe[:, None])
+    o_ref[0] = o.astype(o_ref.dtype)
+    lse = jnp.where(empty, _NEG_INF, m + jnp.log(l_safe))
+    lse_ref[0] = lax.broadcast_in_dim(lse, (lse_ref.shape[1], _LANES), (0,))
+
+
+def _p_from_lse(s, lse):
+    """exp(s − lse) with the empty-row guard (lse = −BIG would overflow)."""
+    return jnp.where(
+        (lse <= _NEG_INF / 2)[:, None], 0.0, jnp.exp(s - lse[:, None])
     )
 
 
-# ---------------------------------------------------------------------------
-# Backward kernels (Flash-2: recompute P blockwise from q, k and the saved
-# logsumexp; delta = rowsum(dO ⊙ O) precomputed in XLA).
-# ---------------------------------------------------------------------------
-
-
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k, causal, scale
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, block_k, causal, scale,
 ):
     bq, d = q_ref.shape[1], q_ref.shape[2]
     t = k_ref.shape[1]
     qi = pl.program_id(1)
+    qoff, koff = qoff_ref[0], koff_ref[0]
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
 
-    n_k = (qi * bq + bq + block_k - 1) // block_k if causal else t // block_k
+    n_k = _causal_bounds(qoff, koff, qi, bq, block_k, t, causal=causal)
 
     def body(ki, dq):
         k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
@@ -147,12 +195,8 @@ def _bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )
         if causal:
-            q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_pos = ki * block_k + lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+            s = _mask(s, qoff, koff, qi, bq, ki, block_k)
+        p = _p_from_lse(s, lse)  # [bq, bk]
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -168,18 +212,23 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,
     *, block_q, causal, scale,
 ):
     bk, d = k_ref.shape[1], k_ref.shape[2]
     t = q_ref.shape[1]
     ki = pl.program_id(1)
+    qoff, koff = qoff_ref[0], koff_ref[0]
     k_blk = k_ref[0].astype(jnp.float32)
     v_blk = v_ref[0].astype(jnp.float32)
 
     n_q = t // block_q
-    # First query tile that intersects the triangle for this key tile.
-    q_start = (ki * bk) // block_q if causal else 0
+    if causal:
+        # First query tile whose rows can see this key tile.
+        q_start = jnp.clip((koff + ki * bk - qoff) // block_q, 0, n_q)
+    else:
+        q_start = 0
 
     def body(qi, carry):
         dk, dv = carry
@@ -192,12 +241,8 @@ def _bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )  # [bq, bk]
         if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0
-            )
-            k_pos = ki * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+            s = _mask(s, qoff, koff, qi, block_q, ki, bk)
+        p = _p_from_lse(s, lse)
         dv_new = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -232,16 +277,14 @@ def _specs(block_rows: int, d: int):
     )
 
 
-# Per-row scalars (logsumexp, delta) carry a broadcast 128-lane minor dim so
-# their blocks satisfy the TPU (8, 128) tiling rule (the in-tree flash
-# kernels use the same trick; MIN_BLOCK_SIZE=128).
-_LANES = 128
-
-
 def _row_spec(block_rows: int):
     return pl.BlockSpec(
         (1, block_rows, _LANES), lambda bh, i: (bh, i, 0), memory_space=pltpu.VMEM
     )
+
+
+def _smem_scalar():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
 def _vma(x):
@@ -250,35 +293,40 @@ def _vma(x):
     return getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
 
 
-def _fwd_3d(q, k, v, *, causal, block_q, block_k, interpret):
+def _off(x):
+    return jnp.asarray(x, jnp.int32).reshape((1,))
+
+
+def _fwd_3d(q, k, v, qoff, koff, *, causal, block_q, block_k, interpret):
     bh, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
     grid = (bh, t // block_q)
     kern = functools.partial(
         _fwd_kernel, block_k=block_k, causal=causal, scale=scale
     )
+    full = pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0), memory_space=pltpu.VMEM)
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
-        in_specs=[
-            _specs(block_q, d),
-            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0), memory_space=pltpu.VMEM),
-        ],
+        in_specs=[_smem_scalar(), _smem_scalar(), _specs(block_q, d), full, full],
         out_specs=[_specs(block_q, d), _row_spec(block_q)],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=_vma(q)),
             jax.ShapeDtypeStruct((bh, t, _LANES), jnp.float32, vma=_vma(q)),
         ],
         interpret=bool(interpret),
-    )(q, k, v)
+    )(qoff, koff, q, k, v)
     return o, lse
 
 
-def _bwd_3d(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
+def _bwd_3d(q, k, v, o, lse, do, g_lse, qoff, koff, *, causal, block_q, block_k, interpret):
     bh, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
+    # Flash-2 delta, with the lse cotangent folded in: ∂lse/∂S = P, so a
+    # direct lse cotangent g adds g·P to dS — i.e. delta → delta − g.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse
     delta = jnp.broadcast_to(delta[..., None], (bh, t, _LANES))
 
     full = lambda: pl.BlockSpec(
@@ -294,6 +342,7 @@ def _bwd_3d(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
         ),
         grid=(bh, t // block_q),
         in_specs=[
+            _smem_scalar(), _smem_scalar(),
             _specs(block_q, d),  # q tile
             full(),  # k
             full(),  # v
@@ -304,7 +353,7 @@ def _bwd_3d(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
         out_specs=_specs(block_q, d),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=_vma(q)),
         interpret=bool(interpret),
-    )(q, k, v, do, lse, delta)
+    )(qoff, koff, q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -312,6 +361,7 @@ def _bwd_3d(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
         ),
         grid=(bh, t // block_k),
         in_specs=[
+            _smem_scalar(), _smem_scalar(),
             full(),  # q
             _specs(block_k, d),  # k tile
             _specs(block_k, d),  # v tile
@@ -325,7 +375,7 @@ def _bwd_3d(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, t, d), v.dtype, vma=_vma(q)),
         ],
         interpret=bool(interpret),
-    )(q, k, v, do, lse, delta)
+    )(qoff, koff, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
@@ -344,33 +394,103 @@ def _from3d(x, b, h):
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, qoff, koff, causal, block_q, block_k, interpret):
+    (out, lse), _ = _flash_fwd(
+        q, k, v, qoff, koff, causal, block_q, block_k, interpret
+    )
+    return out, lse
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, qoff, koff, causal, block_q, block_k, interpret):
     b, t, h, d = q.shape
-    o3, lse = _fwd_3d(
-        _to3d(q), _to3d(k), _to3d(v),
+    o3, lse3 = _fwd_3d(
+        _to3d(q), _to3d(k), _to3d(v), qoff, koff,
         causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
     )
     out = _from3d(o3, b, h)
-    return out, (q, k, v, out, lse)
+    lse = lse3[:, :, 0].reshape(b, h, t)
+    return (out, lse), (q, k, v, out, lse3, qoff, koff)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
+    q, k, v, out, lse3, qoff, koff = res
+    g_o, g_lse = g
     b, t, h, d = q.shape
+    # Note: without symbolic_zeros on the custom_vjp, a discarded lse
+    # output still arrives as a dense zeros cotangent — the fold below then
+    # costs one elementwise subtract on [BH, T], negligible vs attention.
+    g_lse3 = g_lse.reshape(b * h, t)
     dq3, dk3, dv3 = _bwd_3d(
-        _to3d(q), _to3d(k), _to3d(v), _to3d(out), lse, _to3d(g),
+        _to3d(q), _to3d(k), _to3d(v), _to3d(out), lse3, _to3d(g_o), g_lse3,
+        qoff, koff,
         causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return _from3d(dq3, b, h), _from3d(dk3, b, h), _from3d(dv3, b, h)
+    f0 = np.zeros((1,), jax.dtypes.float0)  # int offsets: no cotangent
+    return _from3d(dq3, b, h), _from3d(dk3, b, h), _from3d(dv3, b, h), f0, f0
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_block(
+    q,
+    k,
+    v,
+    *,
+    q_offset=0,
+    k_offset=0,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """One attention *block* of a longer sequence: ``(o, lse)`` outputs.
+
+    ``q_offset``/``k_offset`` (python ints or traced int scalars — e.g.
+    ``axis_index * T_local`` inside shard_map) place this [B, Tq, H, D]
+    query block and [B, Tk, H, D] key/value block in the global sequence
+    for causal masking. Key blocks wholly in the future produce ``o = 0``
+    and ``lse = −BIG``, the neutral element of :func:`merge_attention` —
+    which is how ring attention composes blocks. Differentiable in
+    q/k/v through both outputs.
+    """
+    tq, tk = q.shape[1], k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if not _use_kernel(interpret):
+        return reference_attention_with_lse(
+            q, k, v, q_offset=q_offset, k_offset=k_offset, causal=causal
+        )
+    if tq % block_q or tk % block_k:
+        raise ValueError(
+            f"seq lens ({tq}, {tk}) must be divisible by blocks "
+            f"({block_q}, {block_k})"
+        )
+    if tq != tk:
+        raise ValueError(
+            f"block kernel requires Tq == Tk (ring shards are equal); "
+            f"got {tq} vs {tk}"
+        )
+    if interpret is None:
+        interpret = False
+    return _flash(
+        q, k, v, _off(q_offset), _off(k_offset),
+        causal, block_q, block_k, interpret,
+    )
+
+
+def merge_attention(o_a, lse_a, o_b, lse_b):
+    """Merge two attention partial results over disjoint key sets.
+
+    Inputs/outputs: ``o [B, T, H, D]`` (normalized within its key set),
+    ``lse [B, H, T]``. Exact online-softmax combination; ``lse = −BIG``
+    partials (fully-masked blocks) are absorbed as no-ops.
+    """
+    lse_new = jnp.logaddexp(lse_a, lse_b)
+    w_a = jnp.exp(lse_a - lse_new).transpose(0, 2, 1)[..., None]
+    w_b = jnp.exp(lse_b - lse_new).transpose(0, 2, 1)[..., None]
+    return (o_a * w_a + o_b * w_b).astype(o_a.dtype), lse_new
 
 
 def flash_attention(
@@ -405,4 +525,7 @@ def flash_attention(
         )
     if interpret is None:
         interpret = False
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    o, _ = _flash(
+        q, k, v, _off(0), _off(0), causal, block_q, block_k, interpret
+    )
+    return o

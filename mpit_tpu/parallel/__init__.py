@@ -26,7 +26,7 @@ stretch config, BASELINE.json) and the task charter, built TPU-first:
   ``expert`` mesh axis.
 """
 
-from mpit_tpu.parallel.ring_attention import ring_attention
+from mpit_tpu.parallel.ring_attention import ring_attention, ring_flash_attention
 from mpit_tpu.parallel.ulysses import ulysses_attention
 from mpit_tpu.parallel.tp import (
     gpt2_tp_rules,
@@ -44,6 +44,7 @@ from mpit_tpu.parallel.moe import MoEMLP, expert_parallel_moe
 
 __all__ = [
     "ring_attention",
+    "ring_flash_attention",
     "ulysses_attention",
     "gpt2_tp_rules",
     "fsdp_rules",

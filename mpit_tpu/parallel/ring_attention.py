@@ -115,3 +115,73 @@ def ring_attention(q, k, v, *, axis: str = "seq", causal: bool = True):
     )
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+def ring_flash_attention(
+    q,
+    k,
+    v,
+    *,
+    axis: str = "seq",
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Ring attention with the fused Pallas kernel as the per-block compute.
+
+    Same contract as :func:`ring_attention` (shapes [B, T_local, H, D] in a
+    ``shard_map`` sharded over ``axis``; exact), but each ring step runs
+    :func:`mpit_tpu.ops.flash_attention_block` — the offset-aware flash
+    kernel — instead of materialized blockwise attention, and partials
+    combine through the differentiable lse-merge
+    (:func:`mpit_tpu.ops.merge_attention`). The kernel's second output
+    carries its lse cotangent back through the Flash-2 backward, so the
+    whole ring trains end-to-end with no extra backward machinery.
+
+    On non-TPU backends the per-block kernel falls back to XLA (same
+    math), which is how the CPU fake mesh tests it.
+    """
+    from mpit_tpu.ops.flash_attention import (
+        _NEG_INF as NEG,
+        flash_attention_block,
+        merge_attention,
+    )
+
+    p_size = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    t_local = q.shape[1]
+    q_offset = idx * t_local
+
+    b, tq, h, d = q.shape
+    # f32 accumulator: merging in q.dtype (bf16) would compound a rounding
+    # per ring step; merge_attention preserves o_a's dtype, so seeding f32
+    # keeps every merge in f32 and the single down-cast happens at return.
+    o, lse = C.vary(
+        (
+            jnp.zeros((b, tq, h, d), jnp.float32),
+            jnp.full((b, h, tq), NEG, jnp.float32),
+        ),
+        axis,
+    )
+
+    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+
+    def ring_step(s, carry):
+        o, lse, k_blk, v_blk = carry
+        src = (idx - s) % p_size
+        o_b, lse_b = flash_attention_block(
+            q, k_blk, v_blk,
+            q_offset=q_offset, k_offset=src * t_local,
+            causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+        o, lse = merge_attention(o, lse, o_b, lse_b)
+        k_blk = lax.ppermute(k_blk, axis, perm=perm)
+        v_blk = lax.ppermute(v_blk, axis, perm=perm)
+        return o, lse, k_blk, v_blk
+
+    o, lse, _, _ = lax.fori_loop(
+        0, p_size, ring_step, (o, lse, k, v), unroll=True
+    )
+    return o.astype(q.dtype)
