@@ -50,6 +50,8 @@ class TrainConfig:
     ckpt_dir: str = ""  # orbax checkpoint directory ("" = no checkpoints)
     ckpt_every: int = 0
     eval_batch: int = 256
+    max_restores: int = 1  # checkpoint restores after a diverged loss
+    spike_factor: float = 0.0  # >0: treat loss > factor*EMA as divergence
     seed: int = 0
 
     def mesh_shape(self) -> dict[str, int] | None:

@@ -33,6 +33,8 @@ from mpit_tpu.asyncsgd.config import TrainConfig
 from mpit_tpu.data import Prefetcher
 from mpit_tpu.train import (
     CheckpointManager,
+    Diverged,
+    DivergenceGuard,
     MetricLogger,
     Throughput,
     make_eval_step,
@@ -119,7 +121,6 @@ def run_spmd(
 
     logger = MetricLogger()
     meter = Throughput()
-    losses: list[float] = []
     start_step = int(state.step)
     # Resume continues the stream, not restarts it: skip the batches the
     # checkpointed steps already consumed so the resumed trajectory matches
@@ -140,44 +141,102 @@ def run_spmd(
     if cfg.profile_dir and cfg.steps > start_step:
         last = cfg.steps - 1
         prof_window = (min(start_step + 2, last), min(start_step + 5, last))
+    # Failure detection (SURVEY.md §6): a non-finite/spiking loss at a
+    # checked step triggers a restore (when checkpoints exist) and the run
+    # continues — up to cfg.max_restores times. Checks run at BOTH log and
+    # save points, so a checkpoint is never written on a failing loss.
+    # (Residual window: loss at step t certifies the params *entering* t,
+    # so the state saved at t could in principle already be poisoned while
+    # loss_t is finite — which is why repeat divergence steps back to an
+    # OLDER checkpoint instead of reloading the same one.) After a restore
+    # the stream keeps its position: an interrupted data order is part of
+    # divergence recovery; exact replay is only for clean resume.
+    guard_ = DivergenceGuard(spike_factor=cfg.spike_factor)
+    restores = 0
+    restore_before: int | None = None  # ceiling for the next restore target
+
+    loss_trace: list[tuple[int, float]] = []
     tracing = False
+    trace_done = False
+    step = start_step
     try:
         with Prefetcher(world, batches, axis=axis) as stream:
-            for i, batch in enumerate(stream):
-                step = start_step + i
+            for batch in stream:
                 if step >= cfg.steps:
                     break
-                if prof_window and step == prof_window[0]:
+                if (
+                    prof_window
+                    and not tracing
+                    and not trace_done
+                    and step == prof_window[0]
+                ):
                     jax.profiler.start_trace(cfg.profile_dir)
                     tracing = True
                 state, metrics = step_fn(state, batch)
-                if tracing and step == prof_window[1]:
+                if tracing and step >= prof_window[1]:
                     float(metrics["loss"])  # host fetch: trace covers real work
                     jax.profiler.stop_trace()
                     tracing = False
+                    trace_done = True
                 rate = meter.tick(items)
-                if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+                should_log = (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps
+                should_save = bool(
+                    ckpt and cfg.ckpt_every and (step + 1) % cfg.ckpt_every == 0
+                )
+                if should_log or should_save:
                     loss = float(metrics["loss"])
-                    losses.append(loss)
-                    logger.log(
-                        step + 1,
-                        {**{k: float(v) for k, v in metrics.items()},
-                         "items_per_sec": rate},
-                    )
-                if ckpt and cfg.ckpt_every and (step + 1) % cfg.ckpt_every == 0:
-                    ckpt.save(step + 1, state)
+                    try:
+                        guard_.check(step + 1, loss)
+                    except Diverged:
+                        candidates = [
+                            s
+                            for s in (ckpt.all_steps() if ckpt else [])
+                            if restore_before is None or s < restore_before
+                        ]
+                        if not candidates or restores >= cfg.max_restores:
+                            raise
+                        target = max(candidates)
+                        restores += 1
+                        state = ckpt.restore(
+                            state, state_specs(params, extra), step=target
+                        )
+                        step = int(state.step)
+                        restore_before = target
+                        guard_.reset()
+                        loss_trace = [(s, l) for s, l in loss_trace if s <= step]
+                        logger.log(
+                            step,
+                            {"event": "restored_after_divergence",
+                             "bad_loss": loss, "restores": restores},
+                        )
+                        continue
+                    if should_log:
+                        loss_trace.append((step + 1, loss))
+                        logger.log(
+                            step + 1,
+                            {**{k: float(v) for k, v in metrics.items()},
+                             "items_per_sec": rate},
+                        )
+                    if should_save:
+                        ckpt.save(step + 1, state)
+                        # A new guard-passing checkpoint supersedes the
+                        # poisoned-latest suspicion from a past restore.
+                        restore_before = None
+                step += 1
     finally:
         if tracing:  # run ended (or raised) inside the window
             jax.profiler.stop_trace()
     if ckpt:
         ckpt.wait()
 
+    losses = [l for _, l in loss_trace]
     out = {
         "mode": "spmd",
         "world": repr(mpit_tpu.comm.get_world()),
         "steps": int(state.step),
         "losses": losses,
         "final_loss": losses[-1] if losses else float("nan"),
+        "restores": restores,
     }
     if eval_fn is not None and eval_batch is not None:
         ev = make_eval_step(eval_fn, world, axis=axis)

@@ -14,12 +14,15 @@ along the data axis and optimizer state sharded across chips.
 - :mod:`mpit_tpu.train.metrics` — step metrics, throughput meters, JSONL.
 """
 
+from mpit_tpu.train.guard import Diverged, DivergenceGuard
 from mpit_tpu.train.step import TrainState, make_eval_step, make_train_step
 from mpit_tpu.train.loop import Trainer
 from mpit_tpu.train.checkpoint import CheckpointManager
 from mpit_tpu.train.metrics import MetricLogger, Throughput
 
 __all__ = [
+    "Diverged",
+    "DivergenceGuard",
     "TrainState",
     "make_train_step",
     "make_eval_step",

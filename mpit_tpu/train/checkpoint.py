@@ -68,6 +68,9 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
     def wait(self) -> None:
         """Block until pending async saves are durable."""
         self._mgr.wait_until_finished()

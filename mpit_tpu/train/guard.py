@@ -1,0 +1,68 @@
+"""Failure detection + recovery (SURVEY.md §6 "Failure detection" row).
+
+The reference has none: a diverged or dead worker hangs/aborts the whole
+``mpirun`` job. TPU-natively the failure modes that remain after the SPMD
+collapse are *numeric* — a NaN/Inf loss or a blow-up — and the recovery
+story is checkpoint-restart (SURVEY.md §6): detect at the metric fetch
+(which the loop already pays for), restore the last good sharded
+checkpoint, and continue.
+
+Detection is deliberately cheap: checks ride the existing log-point host
+fetch; no extra device syncs are inserted into the hot loop.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+class Diverged(RuntimeError):
+    """Training produced a non-finite or exploding loss."""
+
+    def __init__(self, step: int, loss: float, reason: str):
+        super().__init__(
+            f"training diverged at step {step}: loss={loss} ({reason})"
+        )
+        self.step = step
+        self.loss = loss
+        self.reason = reason
+
+
+class DivergenceGuard:
+    """Loss sanity checks at log points.
+
+    - non-finite loss: always fatal (raises :class:`Diverged`);
+    - spike detection (opt-in via ``spike_factor > 0``): raises when the
+      loss exceeds ``spike_factor ×`` its EMA, after ``warmup`` healthy
+      checks (early-training noise is not a spike).
+    """
+
+    def __init__(self, *, spike_factor: float = 0.0, ema: float = 0.9, warmup: int = 5):
+        self.spike_factor = spike_factor
+        self._ema_coef = ema
+        self._warmup = warmup
+        self._ema: float | None = None
+        self._window: list[float] = []
+
+    def check(self, step: int, loss: float) -> None:
+        if not math.isfinite(loss):
+            raise Diverged(step, loss, "non-finite")
+        if len(self._window) < self._warmup:
+            # Warmup: tolerate transients AND keep them out of the
+            # baseline — the EMA seeds from the warmup *median*, so one
+            # huge early outlier cannot inflate it and mask later spikes.
+            self._window.append(loss)
+            if len(self._window) == self._warmup:
+                self._ema = sorted(self._window)[self._warmup // 2]
+            return
+        assert self._ema is not None
+        if self.spike_factor > 0 and loss > self.spike_factor * self._ema:
+            raise Diverged(
+                step, loss, f"spike > {self.spike_factor}x EMA {self._ema:.4g}"
+            )
+        self._ema = self._ema_coef * self._ema + (1 - self._ema_coef) * loss
+
+    def reset(self) -> None:
+        """Forget history (call after a checkpoint restore)."""
+        self._ema = None
+        self._window = []

@@ -1,0 +1,88 @@
+"""Failure detection + checkpoint-restore recovery (SURVEY.md §6)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpit_tpu.train import Diverged, DivergenceGuard
+
+
+class TestDivergenceGuard:
+    def test_non_finite_always_fatal(self):
+        g = DivergenceGuard()
+        g.check(1, 2.0)
+        with pytest.raises(Diverged, match="non-finite"):
+            g.check(2, float("nan"))
+        with pytest.raises(Diverged):
+            DivergenceGuard().check(1, float("inf"))
+
+    def test_spike_detection_after_warmup(self):
+        g = DivergenceGuard(spike_factor=5.0, warmup=3)
+        for s in range(3):
+            g.check(s, 1.0)
+        g.check(3, 2.0)  # 2x: fine
+        with pytest.raises(Diverged, match="spike"):
+            g.check(4, 50.0)
+
+    def test_early_spikes_tolerated(self):
+        g = DivergenceGuard(spike_factor=5.0, warmup=5)
+        g.check(0, 1.0)
+        g.check(1, 100.0)  # within warmup: allowed
+
+    def test_reset_forgets_history(self):
+        g = DivergenceGuard(spike_factor=5.0, warmup=1)
+        g.check(0, 1.0)
+        g.check(1, 1.0)
+        g.reset()
+        g.check(2, 100.0)  # fresh history: no spike baseline
+
+
+class TestRecoveryIntegration:
+    def _run(self, tmp_path, poison_step, max_restores):
+        """MNIST-shaped run whose stream yields one NaN-poisoned batch."""
+        from mpit_tpu.asyncsgd import runner
+        from mpit_tpu.asyncsgd.config import TrainConfig
+        from mpit_tpu.data import synthetic_mnist
+        from mpit_tpu.models import LeNet
+
+        cfg = TrainConfig(
+            steps=10, batch_size=16, log_every=1, ckpt_dir=str(tmp_path),
+            ckpt_every=2, max_restores=max_restores,
+        )
+        ds = synthetic_mnist()
+        model = LeNet()
+
+        def stream():
+            for i, b in enumerate(ds.batches(cfg.batch_size)):
+                if i == poison_step:
+                    b = dict(b, image=np.full_like(b["image"], np.nan))
+                yield b
+
+        def init_params():
+            return (
+                model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"],
+                (),
+            )
+
+        def loss_fn(params, batch):
+            logits = model.apply({"params": params}, batch["image"])
+            logp = jax.nn.log_softmax(logits)
+            loss = -jnp.mean(
+                jnp.take_along_axis(logp, batch["label"][:, None], axis=1)
+            )
+            return loss, {}
+
+        return runner.run_spmd(cfg, stream(), loss_fn, init_params)
+
+    def test_restores_and_completes(self, tmp_path):
+        out = self._run(tmp_path, poison_step=5, max_restores=2)
+        assert out["restores"] == 1
+        assert out["steps"] == 10
+        assert np.isfinite(out["final_loss"])
+
+    def test_raises_without_restore_budget(self, tmp_path):
+        with pytest.raises(Diverged):
+            self._run(tmp_path, poison_step=5, max_restores=0)
