@@ -88,7 +88,54 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
     mesh_shape = cfg.mesh_shape()
     batches = runner.make_stream(cfg, dataset, cfg.seq_len)
 
-    if not mesh_shape or "model" not in mesh_shape:
+    def drive(world, init_fn, step_fn, make_batch):
+        """Shared loop for the hand-driven tiers (cp / pjit-TP)."""
+        params, _ = init_params()
+        state = init_fn(params)
+        logger, meter, losses = MetricLogger(), Throughput(), []
+        for step in range(cfg.steps):
+            state, metrics = step_fn(state, make_batch(next(batches)))
+            rate = meter.tick(cfg.batch_size * cfg.seq_len)
+            if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+                losses.append(float(metrics["loss"]))
+                logger.log(step + 1, {"loss": losses[-1], "tokens_per_sec": rate})
+        return state, losses
+
+    if mesh_shape and "seq" in mesh_shape:
+        # Context-parallel tier: sequence sharded over the seq axis, ring
+        # attention inside, cross-shard next-token targets (parallel.cp).
+        if cfg.ckpt_dir:
+            raise SystemExit(
+                "gpt2: --ckpt-dir is not yet supported on the cp tier"
+            )
+        if "model" in mesh_shape:
+            raise SystemExit(
+                "gpt2: a mesh with both 'seq' and 'model' axes is not "
+                "supported — the cp tier would leave the model axis doing "
+                "replicated work; pick one of --mesh data=..,seq=.. or "
+                "--mesh data=..,model=.."
+            )
+        if "data" not in mesh_shape:
+            # Pure CP: a trivial 1-wide data axis keeps the step's specs.
+            mesh_shape = {"data": 1, **mesh_shape}
+        from jax.sharding import PartitionSpec as P_
+        from mpit_tpu.data import shard_batch
+        from mpit_tpu.parallel import make_gpt2_cp_train_step
+
+        world = mpit_tpu.init(mesh_shape)
+        init_fn, step_fn, _ = make_gpt2_cp_train_step(
+            mcfg, tx, world, zero1=cfg.zero1, flash=cfg.flash
+        )
+        state, losses = drive(
+            world, init_fn, step_fn,
+            lambda b: shard_batch(
+                world,
+                {"tokens": np.asarray(b["tokens"])[:, : cfg.seq_len]},
+                spec=P_("data", "seq"),
+            ),
+        )
+        tier = "cp-ring" + ("-flash" if cfg.flash else "")
+    elif not mesh_shape or "model" not in mesh_shape:
         # shard_map tier: plain sync DP + ZeRO-1 via the common runner
         # (checkpoint/resume included), with the adam-family tx override.
         out = runner.run_spmd(
@@ -120,16 +167,9 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             gpt2_tp_rules("model"),
             fsdp_axis=cfg.fsdp_axis or None,
         )
-        params, _ = init_params()
-        state = init_fn(params)
-        logger, meter, losses = MetricLogger(), Throughput(), []
-        for step in range(cfg.steps):
-            batch = jax.tree.map(np.asarray, next(batches))
-            state, metrics = step_fn(state, batch)
-            rate = meter.tick(cfg.batch_size * cfg.seq_len)
-            if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
-                losses.append(float(metrics["loss"]))
-                logger.log(step + 1, {"loss": losses[-1], "tokens_per_sec": rate})
+        state, losses = drive(
+            world, init_fn, step_fn, lambda b: jax.tree.map(np.asarray, b)
+        )
         tier = "pjit-tp" + ("+fsdp" if cfg.fsdp_axis else "")
 
     return {

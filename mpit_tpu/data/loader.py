@@ -25,21 +25,31 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
-def shard_batch(world, batch, *, axis: str = "data"):
-    """Place a global host batch sharded along ``axis`` over the mesh.
+def shard_batch(world, batch, *, axis: str = "data", spec: P | None = None):
+    """Place a global host batch sharded over the mesh.
 
-    Each array's leading dimension must be divisible by the axis size.
-    Returns a pytree of committed ``jax.Array``s (zero-copy per-device
-    slices where the platform allows).
+    Default layout: leading dimension sharded along ``axis``. Pass ``spec``
+    for multi-dim layouts (e.g. ``P("data", "seq")`` shards batch over
+    data and sequence over the seq axis — the context-parallel input).
+    Sharded dims must divide by their axis sizes. Returns a pytree of
+    committed ``jax.Array``s.
     """
-    sharding = NamedSharding(world.mesh, P(axis))
+    sharding = NamedSharding(world.mesh, spec if spec is not None else P(axis))
 
     def put(x):
         x = np.asarray(x)
-        if x.shape[0] % world.axis_size(axis):
-            raise ValueError(
-                f"batch dim {x.shape[0]} not divisible by {axis}={world.axis_size(axis)}"
-            )
+        for dim, name in enumerate(sharding.spec):
+            if name is None:
+                continue
+            names = (name,) if isinstance(name, str) else name
+            size = 1
+            for a in names:
+                size *= world.axis_size(a)
+            if x.shape[dim] % size:
+                raise ValueError(
+                    f"batch dim {dim} ({x.shape[dim]}) not divisible by "
+                    f"{names}={size}"
+                )
         return jax.device_put(x, sharding)
 
     return jax.tree.map(put, batch)

@@ -26,6 +26,7 @@ stretch config, BASELINE.json) and the task charter, built TPU-first:
   ``expert`` mesh axis.
 """
 
+from mpit_tpu.parallel.cp import make_gpt2_cp_train_step
 from mpit_tpu.parallel.ring_attention import ring_attention, ring_flash_attention
 from mpit_tpu.parallel.ulysses import ulysses_attention
 from mpit_tpu.parallel.tp import (
@@ -43,6 +44,7 @@ from mpit_tpu.parallel.megatron import (
 from mpit_tpu.parallel.moe import MoEMLP, expert_parallel_moe
 
 __all__ = [
+    "make_gpt2_cp_train_step",
     "ring_attention",
     "ring_flash_attention",
     "ulysses_attention",

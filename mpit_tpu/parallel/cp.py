@@ -1,0 +1,200 @@
+"""Context-parallel training: GPT-2 with the sequence sharded over a mesh axis.
+
+The charter's long-context mandate made concrete: token sequences larger
+than one chip's activation memory train by sharding T over ``seq_axis`` —
+each device holds [B/dp, T/cp] tokens, attention runs as a K/V ring
+(:func:`~mpit_tpu.parallel.ring_attention.ring_attention`, or the fused
+Pallas :func:`~mpit_tpu.parallel.ring_attention.ring_flash_attention`), and
+everything else in the transformer is position-local so it needs no
+communication at all.
+
+The two places sequence sharding actually bites, both handled here:
+
+- **Positions**: device ``s`` embeds global positions ``s·T_loc … s·T_loc +
+  T_loc − 1`` (the ``positions`` argument of
+  :class:`~mpit_tpu.models.gpt2.GPT2`).
+- **Next-token targets cross the shard boundary**: position ``t``'s target
+  is token ``t+1``, so each shard's final target is the *first token of
+  the right neighbor* — one tiny ``ppermute`` (`comm.shift`) per step —
+  and the global last position has no target (masked; the loss divides by
+  the global valid count via a psum so the mean is exact).
+
+Gradient combine: psum over ``seq_axis`` (every device holds full
+replicated params), then ZeRO-1 reduce-scatter/update/all-gather over
+``data_axis`` with sum semantics (the loss is already globally
+normalized), so optimizer state stays sharded exactly as in the pure-DP
+step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mpit_tpu import opt as gopt
+from mpit_tpu.comm import collectives as C
+from mpit_tpu.models.gpt2 import GPT2, GPT2Config
+from mpit_tpu.opt.sharded import state_partition_specs
+from mpit_tpu.parallel.ring_attention import ring_attention, ring_flash_attention
+from mpit_tpu.train.step import TrainState
+
+
+def make_gpt2_cp_train_step(
+    cfg: GPT2Config,
+    tx: optax.GradientTransformation,
+    world,
+    *,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+    zero1: bool = True,
+    flash: bool = False,
+    interpret: bool | None = None,
+    donate: bool = True,
+):
+    """Build ``(init_fn, step_fn, state_specs)`` for sequence-sharded GPT-2.
+
+    The step consumes ``{"tokens": [B_global, T_global]}`` int32 sharded
+    ``P(data_axis, seq_axis)`` (use ``mpit_tpu.data.shard_batch`` with
+    ``spec=P(data_axis, seq_axis)``); ``T_global`` must divide by the seq
+    axis size and exceed it (every shard needs ≥1 position).
+
+    ``flash=True`` rings the fused Pallas block kernel
+    (:func:`ring_flash_attention`); otherwise the XLA blockwise ring.
+    When the flash kernel runs under the Pallas *interpreter* (CPU-mesh
+    testing), the step's shard_map disables VMA checking — the TPU
+    interpreter re-executes kernel jaxprs with refs as plain arrays and
+    loses the declared vma (known jax 0.9 limitation); the compiled TPU
+    path keeps the checker on.
+    """
+    check_vma = not (flash and interpret)
+    axes = (data_axis, seq_axis)
+    n_seq = world.axis_size(seq_axis)
+    n_data = world.axis_size(data_axis)
+
+    if flash:
+        attn = partial(
+            ring_flash_attention, axis=seq_axis, interpret=interpret
+        )
+    else:
+        attn = partial(ring_attention, axis=seq_axis)
+
+    def attention_fn(q, k, v, *, causal=True):
+        return attn(q, k, v, causal=causal)
+
+    model = GPT2(dataclasses.replace(cfg, attention_fn=attention_fn))
+    stx = gopt.sharded(tx, data_axis, mean_grads=False) if zero1 else None
+
+    def state_specs(params, extra=()):
+        del extra
+        if zero1:
+            opt_specs = state_partition_specs(tx, params, n_data, data_axis)
+        else:
+            opt_specs = jax.tree.map(lambda _: P(), jax.eval_shape(tx.init, params))
+        return TrainState(
+            step=P(),
+            params=jax.tree.map(lambda _: P(), params),
+            opt_state=opt_specs,
+            extra=(),
+        )
+
+    def _per_device_init(params):
+        opt_state = stx.init(params) if zero1 else tx.init(params)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state,
+            extra=(),
+        )
+
+    def init_fn(params, extra=()) -> TrainState:
+        del extra
+        specs = state_specs(params)
+        f = world.shard_map(
+            _per_device_init, in_specs=(P(),), out_specs=specs,
+            check_vma=check_vma,
+        )
+        return jax.jit(f)(params)
+
+    def _per_device_step(state: TrainState, batch):
+        tokens = batch["tokens"]  # [b_local, t_local], device-varying
+        t_local = tokens.shape[1]
+        sidx = C.rank(seq_axis)
+        # Values derived only from the seq index are varying over seq but
+        # invariant over data; retype them over data too so they can mix
+        # with the (data, seq)-varying tokens under the VMA checker.
+        positions = C.vary(
+            sidx * t_local + jnp.arange(t_local, dtype=jnp.int32), data_axis
+        )
+
+        # Cross-shard targets: my last position's target is the right
+        # neighbor's first token; the global last position has none.
+        next_first = C.shift(tokens[:, :1], seq_axis, offset=-1)
+        targets = jnp.concatenate([tokens[:, 1:], next_first], axis=1)
+        mask = C.vary(
+            jnp.broadcast_to(
+                jnp.where(
+                    (sidx == n_seq - 1)
+                    & (jnp.arange(t_local) == t_local - 1),
+                    0.0,
+                    1.0,
+                ),
+                targets.shape,
+            ),
+            data_axis,
+        )
+        count = C.allreduce(jnp.sum(mask), axes)
+
+        local_params = C.vary(state.params, axes)
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens, positions)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            # Local weighted sum over the GLOBAL count: summing the per-
+            # device grads then reproduces the exact global-mean gradient.
+            return -jnp.sum(ll * mask) / count
+
+        loss_local, grads = jax.value_and_grad(loss_fn)(local_params)
+        grads = jax.tree.map(lambda g: lax.psum(g, seq_axis), grads)
+
+        if zero1:
+            updates, opt_state = stx.update(grads, state.opt_state, state.params)
+        else:
+            grads = jax.tree.map(lambda g: lax.psum(g, data_axis), grads)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        metrics = {"loss": lax.psum(loss_local, axes)}
+        return (
+            TrainState(
+                step=state.step + 1, params=params, opt_state=opt_state, extra=()
+            ),
+            metrics,
+        )
+
+    compiled: dict = {}
+
+    def step_fn(state: TrainState, batch):
+        # Only the params tree STRUCTURE feeds in_specs; shape/dtype
+        # changes are jit's own retrace concern — no per-step leaf walk.
+        key = jax.tree_util.tree_structure(state.params)
+        f = compiled.get(key)
+        if f is None:
+            specs = state_specs(state.params)
+            f = jax.jit(
+                world.shard_map(
+                    _per_device_step,
+                    in_specs=(specs, P(data_axis, seq_axis)),
+                    out_specs=(specs, P()),
+                    check_vma=check_vma,
+                ),
+                donate_argnums=(0,) if donate else (),
+            )
+            compiled[key] = f
+        return f(state, batch)
+
+    return init_fn, step_fn, state_specs

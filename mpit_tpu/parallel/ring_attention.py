@@ -80,15 +80,18 @@ def ring_attention(q, k, v, *, axis: str = "seq", causal: bool = True):
     q_offset = idx * t_local
 
     b, tq, h, d = q.shape
-    # Accumulators start replicated-typed; mark them device-varying so the
-    # fori_loop carry type is stable under shard_map's VMA checker.
+    # Accumulators start replicated-typed; mark them device-varying over
+    # ALL of q's varying axes (not just the ring axis — under a
+    # data x seq mesh q varies over both) so the fori_loop carry type is
+    # stable under shard_map's VMA checker.
+    vary_axes = tuple(getattr(jax.typeof(q), "vma", None) or (axis,))
     o, l, m = C.vary(
         (
             jnp.zeros((b, tq, h, d), jnp.float32),
             jnp.zeros((b, h, tq), jnp.float32),
             jnp.full((b, h, tq), _NEG_BIG, jnp.float32),
         ),
-        axis,
+        vary_axes,
     )
 
     def ring_step(s, carry):
@@ -157,12 +160,14 @@ def ring_flash_attention(
     # f32 accumulator: merging in q.dtype (bf16) would compound a rounding
     # per ring step; merge_attention preserves o_a's dtype, so seeding f32
     # keeps every merge in f32 and the single down-cast happens at return.
+    # Varied over all of q's axes (see ring_attention).
+    vary_axes = tuple(getattr(jax.typeof(q), "vma", None) or (axis,))
     o, lse = C.vary(
         (
             jnp.zeros((b, tq, h, d), jnp.float32),
             jnp.full((b, h, tq), NEG, jnp.float32),
         ),
-        axis,
+        vary_axes,
     )
 
     perm = [(j, (j + 1) % p_size) for j in range(p_size)]
