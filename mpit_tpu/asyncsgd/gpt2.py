@@ -88,7 +88,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
     mesh_shape = cfg.mesh_shape()
     batches = runner.make_stream(cfg, dataset, cfg.seq_len)
 
-    def drive(world, init_fn, step_fn, make_batch):
+    def drive(init_fn, step_fn, make_batch):
         """Shared loop for the hand-driven tiers (cp / pjit-TP)."""
         params, _ = init_params()
         state = init_fn(params)
@@ -127,7 +127,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             mcfg, tx, world, zero1=cfg.zero1, flash=cfg.flash
         )
         state, losses = drive(
-            world, init_fn, step_fn,
+            init_fn, step_fn,
             lambda b: shard_batch(
                 world,
                 {"tokens": np.asarray(b["tokens"])[:, : cfg.seq_len]},
@@ -168,7 +168,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             fsdp_axis=cfg.fsdp_axis or None,
         )
         state, losses = drive(
-            world, init_fn, step_fn, lambda b: jax.tree.map(np.asarray, b)
+            init_fn, step_fn, lambda b: jax.tree.map(np.asarray, b)
         )
         tier = "pjit-tp" + ("+fsdp" if cfg.fsdp_axis else "")
 

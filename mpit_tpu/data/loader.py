@@ -41,6 +41,11 @@ def shard_batch(world, batch, *, axis: str = "data", spec: P | None = None):
         for dim, name in enumerate(sharding.spec):
             if name is None:
                 continue
+            if dim >= x.ndim:
+                raise ValueError(
+                    f"spec {sharding.spec} names dim {dim} but batch leaf "
+                    f"has only {x.ndim} dims (shape {x.shape})"
+                )
             names = (name,) if isinstance(name, str) else name
             size = 1
             for a in names:

@@ -56,6 +56,11 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
     attention_fn: AttentionFn = default_attention
     remat: bool = False  # jax.checkpoint each block (HBM for FLOPs)
+    # LM-head matmul operand dtype. The [T, d_model] x [vocab, d_model]
+    # logits einsum is the single biggest matmul in the model; bf16
+    # operands with f32 accumulation run it at full MXU rate. f32 default
+    # preserves exact logits for parity tests.
+    head_dtype: Any = jnp.float32
 
     @property
     def head_dim(self) -> int:
@@ -135,8 +140,13 @@ class GPT2(nn.Module):
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        # weight-tied LM head
-        logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32), wte)
+        # weight-tied LM head (f32 accumulation regardless of operand dtype)
+        logits = jnp.einsum(
+            "btd,vd->btv",
+            x.astype(cfg.head_dtype),
+            wte.astype(cfg.head_dtype),
+            preferred_element_type=jnp.float32,
+        )
         return logits
 
     @staticmethod

@@ -40,9 +40,8 @@ from jax.sharding import PartitionSpec as P
 from mpit_tpu import opt as gopt
 from mpit_tpu.comm import collectives as C
 from mpit_tpu.models.gpt2 import GPT2, GPT2Config
-from mpit_tpu.opt.sharded import state_partition_specs
 from mpit_tpu.parallel.ring_attention import ring_attention, ring_flash_attention
-from mpit_tpu.train.step import TrainState
+from mpit_tpu.train.step import TrainState, zero1_state_fns
 
 
 def make_gpt2_cp_train_step(
@@ -75,7 +74,6 @@ def make_gpt2_cp_train_step(
     check_vma = not (flash and interpret)
     axes = (data_axis, seq_axis)
     n_seq = world.axis_size(seq_axis)
-    n_data = world.axis_size(data_axis)
 
     if flash:
         attn = partial(
@@ -88,36 +86,12 @@ def make_gpt2_cp_train_step(
         return attn(q, k, v, causal=causal)
 
     model = GPT2(dataclasses.replace(cfg, attention_fn=attention_fn))
-    stx = gopt.sharded(tx, data_axis, mean_grads=False) if zero1 else None
-
-    def state_specs(params, extra=()):
-        del extra
-        if zero1:
-            opt_specs = state_partition_specs(tx, params, n_data, data_axis)
-        else:
-            opt_specs = jax.tree.map(lambda _: P(), jax.eval_shape(tx.init, params))
-        return TrainState(
-            step=P(),
-            params=jax.tree.map(lambda _: P(), params),
-            opt_state=opt_specs,
-            extra=(),
-        )
-
-    def _per_device_init(params):
-        opt_state = stx.init(params) if zero1 else tx.init(params)
-        return TrainState(
-            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state,
-            extra=(),
-        )
-
-    def init_fn(params, extra=()) -> TrainState:
-        del extra
-        specs = state_specs(params)
-        f = world.shard_map(
-            _per_device_init, in_specs=(P(),), out_specs=specs,
-            check_vma=check_vma,
-        )
-        return jax.jit(f)(params)
+    # Shared ZeRO-1 plumbing (train.step), with SUM reduce semantics: the
+    # CP loss is already normalized by the global token count.
+    stx, state_specs, init_fn = zero1_state_fns(
+        tx, world, axis=data_axis, zero1=zero1,
+        stx=gopt.sharded(tx, data_axis, mean_grads=False) if zero1 else None,
+    )
 
     def _per_device_step(state: TrainState, batch):
         tokens = batch["tokens"]  # [b_local, t_local], device-varying

@@ -43,38 +43,28 @@ class TrainState(NamedTuple):
     extra: Any = ()
 
 
-def make_train_step(
-    loss_fn: Callable,
+def zero1_state_fns(
     tx: optax.GradientTransformation,
     world,
     *,
     axis: str = "data",
     zero1: bool = True,
-    stateful: bool = False,
-    donate: bool = True,
+    stx: optax.GradientTransformation | None = None,
 ):
-    """Build ``(init_fn, step_fn, state_specs)`` for SPMD data-parallel
-    training over ``world``'s ``axis``.
+    """The state plumbing shared by every train-step tier.
 
-    Args:
-      loss_fn: ``loss_fn(params, batch) -> (loss, aux)`` — or, when
-        ``stateful=True``, ``loss_fn(params, extra, batch) -> (loss, aux,
-        new_extra)`` (for models with BatchNorm-style mutable state; the
-        new extra is pmean-synced across replicas).
-      tx: the goo transformation (any optax transform).
-      world: the communication World.
-      axis: mesh data axis name.
-      zero1: shard optimizer state across ``axis`` (reduce-scatter/
-        all-gather path); False = replicated state + plain pmean DP.
-      donate: donate the input state buffers to the step (in-place update).
+    Returns ``(stx, state_specs, init_fn)``:
 
-    Returns:
-      ``init_fn(params, extra=()) -> TrainState`` (host-level),
-      ``step_fn(state, sharded_batch) -> (state, metrics)`` (jitted),
-      ``state_specs(params, extra=()) -> TrainState`` of PartitionSpecs.
+    - ``stx``: the ZeRO-1-wrapped transform (or the one passed in, for
+      tiers that need non-default reduce semantics), ``None`` when
+      ``zero1=False``;
+    - ``state_specs(params, extra=()) -> TrainState`` of PartitionSpecs;
+    - ``init_fn(params, extra=()) -> TrainState`` (host-level, jitted
+      shard_map over ``world``).
     """
     n = world.axis_size(axis)
-    stx = gopt.sharded(tx, axis) if zero1 else None
+    if zero1 and stx is None:
+        stx = gopt.sharded(tx, axis)
 
     def state_specs(params, extra=()):
         if zero1:
@@ -105,6 +95,43 @@ def make_train_step(
             _per_device_init, in_specs=(P(), specs.extra), out_specs=specs
         )
         return jax.jit(f)(params, extra)
+
+    return stx, state_specs, init_fn
+
+
+def make_train_step(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    world,
+    *,
+    axis: str = "data",
+    zero1: bool = True,
+    stateful: bool = False,
+    donate: bool = True,
+):
+    """Build ``(init_fn, step_fn, state_specs)`` for SPMD data-parallel
+    training over ``world``'s ``axis``.
+
+    Args:
+      loss_fn: ``loss_fn(params, batch) -> (loss, aux)`` — or, when
+        ``stateful=True``, ``loss_fn(params, extra, batch) -> (loss, aux,
+        new_extra)`` (for models with BatchNorm-style mutable state; the
+        new extra is pmean-synced across replicas).
+      tx: the goo transformation (any optax transform).
+      world: the communication World.
+      axis: mesh data axis name.
+      zero1: shard optimizer state across ``axis`` (reduce-scatter/
+        all-gather path); False = replicated state + plain pmean DP.
+      donate: donate the input state buffers to the step (in-place update).
+
+    Returns:
+      ``init_fn(params, extra=()) -> TrainState`` (host-level),
+      ``step_fn(state, sharded_batch) -> (state, metrics)`` (jitted),
+      ``state_specs(params, extra=()) -> TrainState`` of PartitionSpecs.
+    """
+    stx, state_specs, init_fn = zero1_state_fns(
+        tx, world, axis=axis, zero1=zero1
+    )
 
     def _per_device_step(state: TrainState, batch):
         # Grads must be taken w.r.t. a device-varying view of the params:
