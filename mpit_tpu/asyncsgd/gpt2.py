@@ -41,6 +41,7 @@ class GPT2TrainConfig(TrainConfig):
     d_model: int = 768
     remat: bool = False
     flash: bool = False  # Pallas flash-attention inner kernel (TPU)
+    ulysses: bool = False  # cp tier: all-to-all Ulysses instead of the ring
     lr: float = 3e-4
     batch_size: int = 8
     fsdp_axis: str = ""  # e.g. "data" to compose ZeRO-3 with TP
@@ -101,6 +102,11 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
                 logger.log(step + 1, {"loss": losses[-1], "tokens_per_sec": rate})
         return state, losses
 
+    if cfg.ulysses and not (mesh_shape and "seq" in mesh_shape):
+        raise SystemExit(
+            "gpt2: --ulysses true requires the cp tier (a mesh with a seq "
+            "axis, e.g. --mesh data=4,seq=2)"
+        )
     if mesh_shape and "seq" in mesh_shape:
         # Context-parallel tier: sequence sharded over the seq axis, ring
         # attention inside, cross-shard next-token targets (parallel.cp).
@@ -124,7 +130,8 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
 
         world = mpit_tpu.init(mesh_shape)
         init_fn, step_fn, _ = make_gpt2_cp_train_step(
-            mcfg, tx, world, zero1=cfg.zero1, flash=cfg.flash
+            mcfg, tx, world, zero1=cfg.zero1, flash=cfg.flash,
+            ulysses=cfg.ulysses,
         )
         state, losses = drive(
             init_fn, step_fn,
@@ -134,7 +141,9 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
                 spec=P_("data", "seq"),
             ),
         )
-        tier = "cp-ring" + ("-flash" if cfg.flash else "")
+        tier = ("cp-ulysses" if cfg.ulysses else "cp-ring") + (
+            "-flash" if cfg.flash else ""
+        )
     elif not mesh_shape or "model" not in mesh_shape:
         # shard_map tier: plain sync DP + ZeRO-1 via the common runner
         # (checkpoint/resume included), with the adam-family tx override.

@@ -41,6 +41,7 @@ from mpit_tpu import opt as gopt
 from mpit_tpu.comm import collectives as C
 from mpit_tpu.models.gpt2 import GPT2, GPT2Config
 from mpit_tpu.parallel.ring_attention import ring_attention, ring_flash_attention
+from mpit_tpu.parallel.ulysses import ulysses_attention
 from mpit_tpu.train.step import TrainState, zero1_state_fns
 
 
@@ -53,6 +54,7 @@ def make_gpt2_cp_train_step(
     seq_axis: str = "seq",
     zero1: bool = True,
     flash: bool = False,
+    ulysses: bool = False,
     interpret: bool | None = None,
     donate: bool = True,
 ):
@@ -63,8 +65,13 @@ def make_gpt2_cp_train_step(
     ``spec=P(data_axis, seq_axis)``); ``T_global`` must divide by the seq
     axis size and exceed it (every shard needs ≥1 position).
 
-    ``flash=True`` rings the fused Pallas block kernel
-    (:func:`ring_flash_attention`); otherwise the XLA blockwise ring.
+    ``flash=True`` uses the fused Pallas kernel (the offset-aware block
+    kernel under the ring, or the full kernel inside Ulysses); otherwise
+    XLA attention. ``ulysses=True`` swaps the K/V ring for the
+    DeepSpeed-Ulysses all-to-all head<->sequence re-shard
+    (:func:`~mpit_tpu.parallel.ulysses.ulysses_attention`) — needs
+    ``num_heads`` divisible by the seq axis size; same exact semantics,
+    different comm pattern (two dense all-to-alls vs P K/V hops).
     When the flash kernel runs under the Pallas *interpreter* (CPU-mesh
     testing), the step's shard_map disables VMA checking — the TPU
     interpreter re-executes kernel jaxprs with refs as plain arrays and
@@ -75,7 +82,15 @@ def make_gpt2_cp_train_step(
     axes = (data_axis, seq_axis)
     n_seq = world.axis_size(seq_axis)
 
-    if flash:
+    if ulysses:
+        if flash:
+            from mpit_tpu.ops import flash_attention
+
+            inner = partial(flash_attention, interpret=interpret)
+        else:
+            from mpit_tpu.ops import reference_attention as inner
+        attn = partial(ulysses_attention, axis=seq_axis, inner=inner)
+    elif flash:
         attn = partial(
             ring_flash_attention, axis=seq_axis, interpret=interpret
         )

@@ -509,15 +509,21 @@ class TestContextParallelTraining:
         mask = jnp.ones_like(ll).at[:, -1].set(0.0)
         return -jnp.sum(ll * mask) / jnp.sum(mask)
 
-    @pytest.mark.parametrize("flash", [False, True])
-    def test_matches_single_device_trajectory(self, flash):
+    @pytest.mark.parametrize(
+        "flash,ulysses",
+        [(False, False), (True, False), (False, True), (True, True)],
+    )
+    def test_matches_single_device_trajectory(self, flash, ulysses):
         import optax
         from mpit_tpu.data import shard_batch
         from mpit_tpu.parallel import make_gpt2_cp_train_step
 
-        cfg, lm, tx, world, model, params = self._setup({"data": 2, "seq": 4})
+        # Ulysses needs num_heads (2) divisible by the seq axis size.
+        mesh = {"data": 4, "seq": 2} if ulysses else {"data": 2, "seq": 4}
+        cfg, lm, tx, world, model, params = self._setup(mesh)
         init_fn, step_fn, _ = make_gpt2_cp_train_step(
-            cfg, tx, world, flash=flash, interpret=True if flash else None
+            cfg, tx, world, flash=flash, ulysses=ulysses,
+            interpret=True if flash else None,
         )
         state = init_fn(params)
         ref_state, ref_params = tx.init(params), params
@@ -546,3 +552,19 @@ class TestContextParallelTraining:
         )
         assert out["tier"] == "cp-ring"
         assert out["final_loss"] < out["uniform_loss"]
+
+
+class TestHeadDtype:
+    def test_bf16_head_matches_f32_head(self):
+        from mpit_tpu.models import GPT2, GPT2Config
+
+        tokens = jax.random.randint(jax.random.key(3), (2, 64), 0, 128)
+        base = GPT2(GPT2Config.tiny(dtype=jnp.float32))
+        fast = GPT2(
+            GPT2Config.tiny(dtype=jnp.float32, head_dtype=jnp.bfloat16)
+        )
+        variables = base.init(jax.random.key(4), tokens)
+        a = np.asarray(base.apply(variables, tokens))
+        b = np.asarray(fast.apply(variables, tokens))
+        assert b.dtype == np.float32  # f32 accumulation preserved
+        np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
