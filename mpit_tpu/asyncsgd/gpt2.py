@@ -42,6 +42,7 @@ class GPT2TrainConfig(TrainConfig):
     remat: bool = False
     flash: bool = False  # Pallas flash-attention inner kernel (TPU)
     ulysses: bool = False  # cp tier: all-to-all Ulysses instead of the ring
+    microbatches: int = 4  # pp tier: GPipe microbatch count
     lr: float = 3e-4
     batch_size: int = 8
     fsdp_axis: str = ""  # e.g. "data" to compose ZeRO-3 with TP
@@ -107,7 +108,51 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             "gpt2: --ulysses true requires the cp tier (a mesh with a seq "
             "axis, e.g. --mesh data=4,seq=2)"
         )
-    if mesh_shape and "seq" in mesh_shape:
+    if mesh_shape and "pipe" in mesh_shape:
+        # Pipeline-parallel tier (parallel.pp): blocks split into stages
+        # over the pipe axis, GPipe microbatch ring, untied LM head.
+        if cfg.ckpt_dir:
+            raise SystemExit("gpt2: --ckpt-dir is not yet supported on the pp tier")
+        if "seq" in mesh_shape or "model" in mesh_shape:
+            raise SystemExit(
+                "gpt2: the pp tier composes only with a data axis "
+                "(--mesh data=..,pipe=..)"
+            )
+        if "data" not in mesh_shape:
+            mesh_shape = {"data": 1, **mesh_shape}
+        if cfg.zero1:
+            raise SystemExit(
+                "gpt2: the pp tier does not support ZeRO-1 yet (per-leaf "
+                "pipe placement vs flat sharding; parallel.pp docstring) — "
+                "pass --zero1 false explicitly"
+            )
+        from mpit_tpu.data import shard_batch
+        from mpit_tpu.parallel import make_gpt2_pp_train_step, split_gpt2_params
+
+        world = mpit_tpu.init(mesh_shape)
+        n_pipe = world.axis_size("pipe")
+        mcfg_pp = dataclasses.replace(mcfg, tie_head=False)
+        pp_model = GPT2(mcfg_pp)
+        init_fn, step_fn, _ = make_gpt2_pp_train_step(
+            mcfg_pp, tx, world, num_microbatches=cfg.microbatches
+        )
+
+        def pp_init():
+            tokens = jnp.zeros((1, cfg.seq_len), jnp.int32)
+            full = jax.jit(pp_model.init)(jax.random.key(cfg.seed), tokens)[
+                "params"
+            ]
+            return split_gpt2_params(full, mcfg_pp.num_layers, n_pipe), ()
+
+        init_params = pp_init  # noqa: F811 — pp uses the split layout
+        state, losses = drive(
+            init_fn, step_fn,
+            lambda b: shard_batch(
+                world, {"tokens": np.asarray(b["tokens"])[:, : cfg.seq_len + 1]}
+            ),
+        )
+        tier = f"pp-gpipe-m{cfg.microbatches}"
+    elif mesh_shape and "seq" in mesh_shape:
         # Context-parallel tier: sequence sharded over the seq axis, ring
         # attention inside, cross-shard next-token targets (parallel.cp).
         if cfg.ckpt_dir:

@@ -83,9 +83,19 @@ def vary(x, axis: AxisName):
     reduce-scatter then double-counts (observed as exactly N× updates).
     Taking the grad w.r.t. a ``vary``-ed copy of the params keeps grads
     local so the training step controls the one reduction itself.
+
+    Idempotent per leaf: axes a leaf already varies over are skipped, so
+    mixed trees (e.g. pipe-sharded stage params next to replicated
+    embeddings) can be varied to a common set in one call.
     """
     names = axis_tuple(axis)
-    return jax.tree.map(lambda l: _pvary(l, names), x)
+
+    def one(l):
+        have = getattr(jax.typeof(l), "vma", frozenset()) or frozenset()
+        missing = tuple(a for a in names if a not in have)
+        return _pvary(l, missing) if missing else l
+
+    return jax.tree.map(one, x)
 
 
 def allreduce(x, axis: AxisName, *, op: str = "sum"):

@@ -61,6 +61,11 @@ class GPT2Config:
     # operands with f32 accumulation run it at full MXU rate. f32 default
     # preserves exact logits for parity tests.
     head_dtype: Any = jnp.float32
+    # Weight-tied LM head (GPT-2's default). Pipeline parallelism unties
+    # it: under a pipe mesh the embedding's wte gradient lives only on
+    # stage 0 while a tied head's would live on every stage, and the two
+    # contributions cannot be combined per-leaf after AD.
+    tie_head: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -140,11 +145,22 @@ class GPT2(nn.Module):
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        # weight-tied LM head (f32 accumulation regardless of operand dtype)
+        # LM head (f32 accumulation regardless of operand dtype); tied to
+        # wte by default, separate under tie_head=False (see GPT2Config).
+        head = (
+            wte
+            if cfg.tie_head
+            else self.param(
+                "head",
+                nn.initializers.normal(0.02),
+                (cfg.vocab_size, cfg.d_model),
+                jnp.float32,
+            )
+        )
         logits = jnp.einsum(
             "btd,vd->btv",
             x.astype(cfg.head_dtype),
-            wte.astype(cfg.head_dtype),
+            head.astype(cfg.head_dtype),
             preferred_element_type=jnp.float32,
         )
         return logits

@@ -36,6 +36,7 @@ from mpit_tpu.parallel.tp import (
     make_pjit_train_step,
 )
 from mpit_tpu.parallel.pipeline import spmd_pipeline
+from mpit_tpu.parallel.pp import make_gpt2_pp_train_step, split_gpt2_params
 from mpit_tpu.parallel.megatron import (
     column_parallel_dense,
     row_parallel_dense,
@@ -45,6 +46,8 @@ from mpit_tpu.parallel.moe import MoEMLP, expert_parallel_moe
 
 __all__ = [
     "make_gpt2_cp_train_step",
+    "make_gpt2_pp_train_step",
+    "split_gpt2_params",
     "ring_attention",
     "ring_flash_attention",
     "ulysses_attention",

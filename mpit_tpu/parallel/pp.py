@@ -1,0 +1,271 @@
+"""Pipeline-parallel GPT-2 training over a ``pipe`` mesh axis.
+
+Completes the tier matrix (DP / TP / CP / **PP**). The transformer's blocks
+are split into ``n_pipe`` contiguous stages; activations move through the
+GPipe microbatch ring of :func:`~mpit_tpu.parallel.pipeline.spmd_pipeline`
+(one jitted SPMD program, differentiable through the reverse pipeline).
+Embedding and LM head run replicated outside the pipeline — cheap next to
+the blocks, and it keeps stage activations shape-invariant as the ring
+requires.
+
+Parameter/gradient geometry (the part worth reading):
+
+- **Stage block params** live only on their pipe device (``P('pipe')`` on
+  the stacked leading axis). AD produces each device's own stage grads —
+  complete as-is; reduced over ``data`` only.
+- **Embedding (wte/wpe)** is consumed by the pipeline's stage-0 ingestion,
+  so its gradient lands only on pipe coordinate 0 → ``psum`` over pipe
+  completes (and re-types) it.
+- **Head/final-LN** grads are computed identically on every pipe device
+  (the pipeline output is broadcast) → a ``pmean`` over pipe is a
+  numerical no-op that re-types them pipe-invariant (psum would multiply
+  by ``n_pipe``).
+- Weight tying would put one parameter (wte) in two categories at once,
+  which per-leaf combine cannot express — the pp tier requires
+  ``GPT2Config.tie_head=False`` (enforced).
+- Optimizer state mirrors the local params per leaf (stage-state leaves
+  sharded ``P('pipe')``). The flat-vector ZeRO-1 wrapper is NOT composed
+  here: raveling pipe-varying stage leaves together with pipe-invariant
+  embedding/head leaves into one flat shard erases the per-leaf
+  placement types — sharded-state PP is future work, so ``zero1`` is
+  rejected rather than silently wrong.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mpit_tpu.comm import collectives as C
+from mpit_tpu.models.gpt2 import Block, GPT2Config
+from mpit_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
+from mpit_tpu.train.step import TrainState
+
+
+def split_gpt2_params(full_params, num_layers: int, n_pipe: int):
+    """GPT2 param tree → ``{"stages": [n_pipe, k, ...], "rest": {...}}``."""
+    if num_layers % n_pipe:
+        raise ValueError(
+            f"num_layers ({num_layers}) must divide by n_pipe ({n_pipe}) — "
+            "a floor split would silently drop trailing blocks"
+        )
+    k = num_layers // n_pipe
+    blocks = [full_params[f"block_{i}"] for i in range(num_layers)]
+    stages = [
+        stack_stage_params(blocks[s * k : (s + 1) * k]) for s in range(n_pipe)
+    ]
+    rest = {
+        name: sub
+        for name, sub in full_params.items()
+        if not name.startswith("block_")
+    }
+    return {"stages": stack_stage_params(stages), "rest": rest}
+
+
+def make_gpt2_pp_train_step(
+    cfg: GPT2Config,
+    tx: optax.GradientTransformation,
+    world,
+    *,
+    data_axis: str = "data",
+    pipe_axis: str = "pipe",
+    num_microbatches: int = 4,
+    zero1: bool = False,
+    donate: bool = True,
+):
+    """Build ``(init_fn, step_fn, state_specs)`` for pipeline-parallel GPT-2.
+
+    Consumes ``{"tokens": [B_global, T+1]}`` sharded ``P(data_axis)``
+    (replicated over pipe); params in the ``split_gpt2_params`` layout.
+    Requires ``cfg.num_layers % n_pipe == 0``, ``cfg.tie_head == False``
+    and per-device batch divisible by ``num_microbatches`` (see module
+    docstring for why, and for the ``zero1`` restriction).
+    """
+    if cfg.tie_head:
+        raise ValueError(
+            "pipeline parallelism requires an untied LM head: "
+            "GPT2Config(tie_head=False) — see parallel.pp docstring"
+        )
+    if zero1:
+        raise NotImplementedError(
+            "ZeRO-1 does not compose with the pp tier yet (flat sharding "
+            "erases per-leaf pipe placement; see parallel.pp docstring)"
+        )
+    n_pipe = world.axis_size(pipe_axis)
+    if cfg.num_layers % n_pipe:
+        raise ValueError(
+            f"num_layers ({cfg.num_layers}) must divide by pipe={n_pipe}"
+        )
+    axes = (data_axis, pipe_axis)
+    block = Block(cfg)
+    apply_block = lambda p, h: block.apply({"params": p}, h)
+    if cfg.remat:
+        # Honor the config's activation checkpointing inside the pipeline
+        # scan, mirroring GPT2.__call__'s nn.remat(Block).
+        apply_block = jax.checkpoint(apply_block)
+
+    def stage_fn(stage_params, x):
+        # Apply this stage's k blocks in order (scan over the stacked axis).
+        def body(h, p):
+            return apply_block(p, h), None
+
+        y, _ = lax.scan(body, x, stage_params)
+        return y
+
+    def _split_specs(split):
+        return {
+            "stages": jax.tree.map(lambda _: P(pipe_axis), split["stages"]),
+            "rest": jax.tree.map(lambda _: P(), split["rest"]),
+        }
+
+    def _local_view(split):
+        """This device's param view: stage leaves sliced to [k, ...]."""
+        return {
+            "stages": jax.tree.map(lambda l: l[0], split["stages"]),
+            "rest": split["rest"],
+        }
+
+    def _opt_specs(split_params):
+        local = jax.eval_shape(_local_view, split_params)
+        shapes = jax.eval_shape(tx.init, local)
+
+        def spec_for(path, leaf):
+            del leaf
+            in_stages = any(
+                getattr(k, "key", getattr(k, "name", None)) == "stages"
+                for k in path
+            )
+            return P(pipe_axis) if in_stages else P()
+
+        return jax.tree_util.tree_map_with_path(spec_for, shapes)
+
+    def state_specs(split_params, extra=()):
+        del extra
+        return TrainState(
+            step=P(),
+            params=_split_specs(split_params),
+            opt_state=_opt_specs(split_params),
+            extra=(),
+        )
+
+    def _per_device_init(split):
+        opt_state = tx.init(_local_view(split))
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=split,
+            opt_state=opt_state,
+            extra=(),
+        )
+
+    def init_fn(split_params, extra=()) -> TrainState:
+        del extra
+        f = world.shard_map(
+            _per_device_init,
+            in_specs=(_split_specs(split_params),),
+            out_specs=state_specs(split_params),
+        )
+        return jax.jit(f)(split_params)
+
+    def _apply_head(rest, h):
+        # flax nn.LayerNorm semantics (f32 compute, eps 1e-6), hand-rolled
+        # because the head runs on the raw pipeline output outside a module.
+        h = h.astype(jnp.float32)
+        mu = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.var(h, axis=-1, keepdims=True)
+        hn = (h - mu) / jnp.sqrt(var + 1e-6)
+        hn = hn * rest["ln_f"]["scale"] + rest["ln_f"]["bias"]
+        return jnp.einsum(
+            "btd,vd->btv",
+            hn.astype(cfg.head_dtype),
+            rest["head"].astype(cfg.head_dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+    def _per_device_step(state: TrainState, batch):
+        tokens = batch["tokens"]  # [b_local, T+1], replicated over pipe
+        inp, targets = tokens[:, :-1], tokens[:, 1:]
+        b, t = inp.shape
+        m = num_microbatches
+        if b % m:
+            raise ValueError(
+                f"per-device batch ({b}) must divide by num_microbatches "
+                f"({m}) — adjust --batch-size or --microbatches"
+            )
+
+        def loss_fn(split):
+            # Keep the [1, k, ...] sharded leading dim: spmd_pipeline
+            # squeezes exactly one leading unit dim itself (pre-squeezing
+            # here would mis-squeeze the k axis when k == 1).
+            local_stage = split["stages"]
+            rest = split["rest"]
+            x = rest["wte"][inp].astype(cfg.dtype) + rest["wpe"][:t].astype(
+                cfg.dtype
+            )
+            xm = x.reshape(m, b // m, t, x.shape[-1])
+            ym = spmd_pipeline(stage_fn, local_stage, xm, axis=pipe_axis)
+            h = ym.reshape(b, t, x.shape[-1])
+            logits = _apply_head(rest, h)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            ll = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+            return -jnp.mean(ll)
+
+        local = C.vary(state.params, axes)
+        loss, grads = jax.value_and_grad(loss_fn)(local)
+
+        # Per-subtree pipe combine (module docstring), then the data mean.
+        def pipe_combine(name, g):
+            if name in ("wte", "wpe"):
+                return jax.tree.map(lambda l: lax.psum(l, pipe_axis), g)
+            return jax.tree.map(lambda l: lax.pmean(l, pipe_axis), g)
+
+        g_rest = {k: pipe_combine(k, v) for k, v in grads["rest"].items()}
+        local_grads = {
+            "stages": jax.tree.map(lambda l: l[0], grads["stages"]),
+            "rest": g_rest,
+        }
+        local_grads = jax.tree.map(
+            lambda g: lax.pmean(g, data_axis), local_grads
+        )
+
+        local_params = _local_view(state.params)
+        updates, opt_state = tx.update(
+            local_grads, state.opt_state, local_params
+        )
+        new_local = optax.apply_updates(local_params, updates)
+        new_params = {
+            "stages": jax.tree.map(lambda l: l[None], new_local["stages"]),
+            "rest": new_local["rest"],
+        }
+        metrics = {"loss": lax.pmean(lax.pmean(loss, pipe_axis), data_axis)}
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=opt_state,
+                extra=(),
+            ),
+            metrics,
+        )
+
+    compiled: dict = {}
+
+    def step_fn(state: TrainState, batch):
+        key = jax.tree_util.tree_structure(state.params)
+        f = compiled.get(key)
+        if f is None:
+            specs = state_specs(state.params)
+            f = jax.jit(
+                world.shard_map(
+                    _per_device_step,
+                    in_specs=(specs, P(data_axis)),
+                    out_specs=(specs, P()),
+                ),
+                donate_argnums=(0,) if donate else (),
+            )
+            compiled[key] = f
+        return f(state, batch)
+
+    return init_fn, step_fn, state_specs
