@@ -13,9 +13,19 @@ derived from the final step (``float(loss)``), not ``block_until_ready``
 return before execution completes, inflating throughput by orders of
 magnitude (observed 258k img/s vs a real ~20k).
 
-``vs_baseline`` is 1.0: the reference publishes no benchmark numbers
-(BASELINE.json ``"published": {}``; see BASELINE.md), so the recorded
-value itself is the cross-round baseline.
+Dispatch amortization: the tunneled chip costs ~10–15 ms per host→device
+dispatch (measured round 2 — comparable to an entire step, and it was
+the round-1 ceiling). Steps therefore run in scanned chunks of K inside
+one compiled call (``make_train_step(scan_steps=K)``): every step still
+executes fully on device over distinct pre-staged batches; the wall
+clock is real; only the host round-trips between steps — pure tunnel
+artifact — are gone.
+
+``vs_baseline``: the reference publishes no benchmark numbers
+(BASELINE.json ``"published": {}``; see BASELINE.md), so per the round-1
+verdict the *round-1 recorded values* are the cross-round baseline —
+``vs_baseline`` is the ratio to ``BENCH_r01.json`` (read at runtime;
+falls back to the recorded constants if the file is gone).
 """
 
 from __future__ import annotations
@@ -28,9 +38,9 @@ import jax.numpy as jnp
 
 
 def _timed_steps(step_fn, state, batches, n):
-    """Run n steps alternating pre-staged batches; returns (dt, loss, state).
-
-    The window closes on a host-value fetch (see module docstring)."""
+    """Run n chunk-calls alternating pre-staged (stacked) batches; returns
+    (dt, loss, state). The window closes on a host-value fetch (see module
+    docstring)."""
     t0 = time.perf_counter()
     metrics = {}
     for i in range(n):
@@ -51,10 +61,30 @@ def _best_window(step_fn, state, batches, steps, repeats=3):
     return best_dt, loss, state
 
 
-def bench_alexnet(batch_per_device: int = 512, steps: int = 20, warmup: int = 3):
+def _stack_batches(world, stream, k: int, spec=None):
+    """Stage k distinct batches on device as one [k, ...]-stacked chunk."""
+    import numpy as np
+
+    from mpit_tpu.data import shard_batch
+
+    host = [next(stream) for _ in range(k)]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *host)
+    return shard_batch(world, stacked, spec=spec)
+
+
+def bench_alexnet(
+    batch_per_device: int = 2048,
+    calls: int = 4,
+    scan_steps: int = 2,
+    warmup: int = 1,
+):
+    """AlexNet headline metric. Round-2 tuning: batch 2048 (512→2048
+    measured 18.0k→22.2k img/s, ~52% MFU by the BENCHMARKS.md accounting;
+    4096 exceeds what the chip's HBM can stage double-buffered)."""
     import mpit_tpu
+    from jax.sharding import PartitionSpec as P
     from mpit_tpu import opt as gopt
-    from mpit_tpu.data import shard_batch, synthetic_imagenet
+    from mpit_tpu.data import synthetic_imagenet
     from mpit_tpu.models import AlexNet
     from mpit_tpu.train import make_train_step
     from mpit_tpu.utils import CommModel
@@ -77,20 +107,23 @@ def bench_alexnet(batch_per_device: int = 512, steps: int = 20, warmup: int = 3)
         return loss, {}
 
     init_fn, step_fn, _ = make_train_step(
-        loss_fn, gopt.goo(0.01, 0.9), world, zero1=True
+        loss_fn, gopt.goo(0.01, 0.9), world, zero1=True, scan_steps=scan_steps
     )
     state = init_fn(params)
 
-    # Two pre-staged batches, alternated, so no step can be served from a
-    # cached/identical-input artifact; successive steps still chain through
-    # the state dependency.
-    ds = synthetic_imagenet()
-    stream = ds.batches(global_batch)
-    batches = [shard_batch(world, next(stream)) for _ in range(2)]
+    # Two pre-staged stacked chunks (scan_steps distinct batches each),
+    # alternated, so no step can be served from a cached/identical-input
+    # artifact; successive steps still chain through the state dependency.
+    stream = synthetic_imagenet().batches(global_batch)
+    batches = [
+        _stack_batches(world, stream, scan_steps, spec=P(None, "data"))
+        for _ in range(2)
+    ]
 
     _, _, state = _timed_steps(step_fn, state, batches, warmup)
-    dt, final_loss, state = _best_window(step_fn, state, batches, steps)
+    dt, final_loss, state = _best_window(step_fn, state, batches, calls)
 
+    steps = calls * scan_steps
     comm = CommModel(params, n, zero1=True)
     return {
         "images_per_sec": round(global_batch * steps / dt, 2),
@@ -98,54 +131,70 @@ def bench_alexnet(batch_per_device: int = 512, steps: int = 20, warmup: int = 3)
         "global_batch": global_batch,
         "batch_per_device": batch_per_device,
         "steps": steps,
+        "scan_steps": scan_steps,
         "final_loss": round(final_loss, 4),
         "grad_sync_bytes_per_step_modeled": comm.grad_sync_bytes(),
     }
 
 
-def bench_gpt2(steps: int = 8, warmup: int = 2):
-    """GPT-2 stretch config: tokens/sec on the shard_map+ZeRO-1 tier."""
+def bench_gpt2(calls: int = 3, scan_steps: int = 4, warmup: int = 1, seq: int = 512):
+    """GPT-2 stretch config: tokens/sec on the shard_map+ZeRO-1 tier.
+
+    Round-2 tuning (all measured on the real chip, see BENCHMARKS.md):
+    batch 32 (b8→b32 raised MFU from 28%→35.6%), bf16 head operands with
+    the fused streaming LM-head loss (the [B,T,50257] f32 logits array is
+    never materialized, ``ops/lm_head.py``), and XLA attention at T=512 —
+    the Pallas flash kernel wins only at longer sequences (it exists for
+    the context-parallel/long-context tiers), so it is selected per shape.
+    """
     import mpit_tpu
-    from mpit_tpu.data import SyntheticLM, shard_batch
+    from jax.sharding import PartitionSpec as P
+    from mpit_tpu.data import SyntheticLM
     from mpit_tpu.models import GPT2, GPT2Config
     from mpit_tpu.opt import goo_adam
     from mpit_tpu.train import make_train_step
 
     world = mpit_tpu.init()
     n = world.num_devices
-    batch, seq = 8 * n, 512
+    batch = 32 * n
     on_tpu = jax.devices()[0].platform == "tpu"
 
-    if on_tpu:
+    kw = dict(max_seq_len=seq, head_dtype=jnp.bfloat16)
+    attention = "xla"
+    if on_tpu and seq >= 1024:
         from mpit_tpu.ops import flash_attention
 
-        cfg = GPT2Config.small(max_seq_len=seq, attention_fn=flash_attention)
-    else:
-        cfg = GPT2Config.small(max_seq_len=seq)
+        kw["attention_fn"] = flash_attention
+        attention = "pallas-flash"
+    cfg = GPT2Config.small(**kw)
     model = GPT2(cfg)
     params = jax.jit(model.init)(
         jax.random.key(0), jnp.zeros((1, seq), jnp.int32)
     )["params"]
 
     def loss_fn(p, b):
-        logits = model.apply({"params": p}, b["tokens"][:, :-1])
-        return GPT2.loss_fn(logits, b["tokens"]), {}
+        return GPT2.fused_loss_fn(model, p, b["tokens"]), {}
 
     init_fn, step_fn, _ = make_train_step(
-        loss_fn, goo_adam(3e-4), world, zero1=True
+        loss_fn, goo_adam(3e-4), world, zero1=True, scan_steps=scan_steps
     )
     state = init_fn(params)
     stream = SyntheticLM(vocab_size=cfg.vocab_size).batches(batch, seq)
-    batches = [shard_batch(world, next(stream)) for _ in range(2)]
+    batches = [
+        _stack_batches(world, stream, scan_steps, spec=P(None, "data"))
+        for _ in range(2)
+    ]
 
     _, _, state = _timed_steps(step_fn, state, batches, warmup)
-    dt, final_loss, state = _best_window(step_fn, state, batches, steps)
+    dt, final_loss, state = _best_window(step_fn, state, batches, calls)
+    steps = calls * scan_steps
     return {
         "tokens_per_sec": round(batch * seq * steps / dt, 1),
         "ms_per_step": round(dt / steps * 1e3, 2),
         "batch": batch,
         "seq_len": seq,
-        "attention": "pallas-flash" if on_tpu else "xla",
+        "scan_steps": scan_steps,
+        "attention": attention,
         "final_loss": round(final_loss, 4),
     }
 
@@ -201,22 +250,45 @@ def bench_allreduce(payload_mb: int = 64, iters: int = 10):
     }
 
 
+def _round1_baselines():
+    """Round-1 recorded values — the cross-round baseline per the judge's
+    protocol ("the measured single-chip numbers are the cross-round
+    baseline now", VERDICT.md round 1). Read from BENCH_r01.json so a
+    corrected record propagates; constants are the fallback."""
+    import os
+
+    alex, gpt2 = 18007.75, 66687.0
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_r01.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)["parsed"]
+        alex = rec["value"]
+        gpt2 = rec["detail"]["gpt2"]["tokens_per_sec"]
+    except (OSError, KeyError, ValueError):
+        pass
+    return alex, gpt2
+
+
 def main():
     alex = bench_alexnet()
     gpt2 = bench_gpt2()
     ar = bench_allreduce()
+    r1_alex, r1_gpt2 = _round1_baselines()
     print(
         json.dumps(
             {
                 "metric": "alexnet_imagenet_images_per_sec",
                 "value": alex["images_per_sec"],
                 "unit": "images/sec",
-                "vs_baseline": 1.0,
+                "vs_baseline": round(alex["images_per_sec"] / r1_alex, 3),
                 "detail": {
                     "devices": jax.device_count(),
                     "platform": jax.devices()[0].platform,
                     "alexnet": alex,
-                    "gpt2": gpt2,
+                    "gpt2": {
+                        **gpt2,
+                        "vs_r1": round(gpt2["tokens_per_sec"] / r1_gpt2, 3),
+                    },
                     "allreduce": ar,
                 },
             }

@@ -38,6 +38,14 @@ class TrainConfig:
     lr: float = 0.05
     momentum: float = 0.9
     weight_decay: float = 0.0
+    # LR schedule (opt/schedules.py): "" = constant (the reference's
+    # behavior), "warmup", "warmup_cosine", "step". Warmup fixes the
+    # documented AlexNet lr-0.01 divergence (BENCHMARKS.md).
+    schedule: str = ""
+    warmup_steps: int = 0
+    lr_end_scale: float = 0.0  # warmup_cosine: final lr as a fraction of lr
+    decay_every: int = 0  # step schedule: decay period
+    decay_factor: float = 0.1  # step schedule: decay multiplier
     zero1: bool = True  # shard goo state across the data axis (SPMD mode)
     easgd: bool = False  # elastic-averaging dynamics instead of Downpour
     easgd_alpha: float = 0.125

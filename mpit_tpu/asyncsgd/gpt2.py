@@ -46,6 +46,8 @@ class GPT2TrainConfig(TrainConfig):
     lr: float = 3e-4
     batch_size: int = 8
     fsdp_axis: str = ""  # e.g. "data" to compose ZeRO-3 with TP
+    fused_loss: bool = True  # streaming LM-head xent (ops/lm_head.py)
+    bf16_head: bool = True  # bf16 head-matmul operands (f32 accumulation)
 
     def model_config(self) -> GPT2Config:
         kw = {}
@@ -53,6 +55,8 @@ class GPT2TrainConfig(TrainConfig):
             from mpit_tpu.ops import flash_attention
 
             kw["attention_fn"] = flash_attention
+        if self.bf16_head:
+            kw["head_dtype"] = jnp.bfloat16
         return GPT2Config(
             vocab_size=self.vocab_size,
             max_seq_len=self.seq_len,
@@ -82,11 +86,18 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
+        if cfg.fused_loss and "model" not in (mesh_shape or {}):
+            # Fused streaming head everywhere except the pjit TP tier,
+            # whose GSPMD rules vocab-shard wte (tp.gpt2_tp_rules) — the
+            # scanned vocab blocks would force an all-gather of the head.
+            return GPT2.fused_loss_fn(model, params, tokens), {}
         logits = model.apply({"params": params}, tokens[:, :-1])
         loss = GPT2.loss_fn(logits, tokens)
         return loss, {}
 
-    tx = goo_adam(cfg.lr, weight_decay=cfg.weight_decay)
+    from mpit_tpu.opt import schedules
+
+    tx = goo_adam(schedules.from_config(cfg), weight_decay=cfg.weight_decay)
     mesh_shape = cfg.mesh_shape()
     batches = runner.make_stream(cfg, dataset, cfg.seq_len)
 
@@ -120,12 +131,6 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             )
         if "data" not in mesh_shape:
             mesh_shape = {"data": 1, **mesh_shape}
-        if cfg.zero1:
-            raise SystemExit(
-                "gpt2: the pp tier does not support ZeRO-1 yet (per-leaf "
-                "pipe placement vs flat sharding; parallel.pp docstring) — "
-                "pass --zero1 false explicitly"
-            )
         from mpit_tpu.data import shard_batch
         from mpit_tpu.parallel import make_gpt2_pp_train_step, split_gpt2_params
 
@@ -134,7 +139,8 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         mcfg_pp = dataclasses.replace(mcfg, tie_head=False)
         pp_model = GPT2(mcfg_pp)
         init_fn, step_fn, _ = make_gpt2_pp_train_step(
-            mcfg_pp, tx, world, num_microbatches=cfg.microbatches
+            mcfg_pp, tx, world, num_microbatches=cfg.microbatches,
+            zero1=cfg.zero1,
         )
 
         def pp_init():

@@ -63,10 +63,13 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         return loss, aux, mutated["batch_stats"]
 
     def eval_fn(params, batch_stats, batch):
-        logits, _ = model.apply(
+        # Inference mode: BN normalizes with the pmean-synced running
+        # averages (no mutable collection) — eval accuracy is a true
+        # inference-mode number (round-1 advisor finding).
+        logits = model.apply(
             {"params": params, "batch_stats": batch_stats},
             batch["image"],
-            mutable=["batch_stats"],
+            train=False,
         )
         return {
             "loss": runner.softmax_xent(logits, batch["label"]),

@@ -61,9 +61,12 @@ def make_stream(cfg: TrainConfig, dataset, *args):
 
 
 def build_tx(cfg: TrainConfig, *, axis: str | None = None):
-    """The goo transformation for a config (Downpour-SGD or EASGD chain)."""
+    """The goo transformation for a config (Downpour-SGD or EASGD chain),
+    with the config's lr schedule (constant when ``cfg.schedule`` is "")."""
     base = gopt.goo(
-        cfg.lr, cfg.momentum, weight_decay=cfg.weight_decay
+        gopt.schedules.from_config(cfg),
+        cfg.momentum,
+        weight_decay=cfg.weight_decay,
     )
     if cfg.easgd:
         # The SPMD spelling of the reference's elastic dynamics: params
@@ -125,8 +128,16 @@ def run_spmd(
     # Resume continues the stream, not restarts it: skip the batches the
     # checkpointed steps already consumed so the resumed trajectory matches
     # an uninterrupted run (streams here are deterministic generators).
-    for _ in range(start_step):
-        next(batches)
+    for skipped in range(start_step):
+        try:
+            next(batches)
+        except StopIteration:
+            raise RuntimeError(
+                f"checkpoint-resume needs to skip {start_step} consumed "
+                f"batches but the stream ended after {skipped} — the "
+                "stream is shorter than the checkpointed run (did the "
+                "data config change between runs?)"
+            ) from None
     items = items_per_batch or cfg.batch_size
 
     # Per-step ICI traffic model (SURVEY.md §6 metrics row), logged once.

@@ -174,6 +174,16 @@ class _SlotIterator:
             pass
 
 
+def _check_ring(depth: int, threads: int) -> None:
+    """Reject ring configs that would hang rather than fail: threads=0
+    builds a loader with no producers (the first ``__next__`` blocks
+    forever in C++ ``pop_ready``); depth=0 deadlocks ``claim_free``."""
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
+    if threads < 1:
+        raise ValueError(f"threads must be >= 1, got {threads}")
+
+
 def classification_stream(
     prototypes: np.ndarray,
     *,
@@ -193,6 +203,7 @@ def classification_stream(
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native data core unavailable: {_BUILD_ERROR}")
+    _check_ring(depth, threads)
     protos = np.ascontiguousarray(prototypes, np.float32)
     num_classes = protos.shape[0]
     sample_shape = protos.shape[1:]
@@ -230,6 +241,7 @@ def lm_stream(
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native data core unavailable: {_BUILD_ERROR}")
+    _check_ring(depth, threads)
     table = np.ascontiguousarray(successors, np.int32)
     vocab, branching = table.shape
     handle = lib.mpit_lm_create(

@@ -115,13 +115,19 @@ class GPT2(nn.Module):
     cfg: GPT2Config = GPT2Config()
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, targets=None):
         """tokens [B, T] int32 → logits [B, T, vocab] float32.
 
         ``positions`` ([T] or [B, T] int32) overrides the default
         ``0..T-1`` — required under context parallelism, where each
         device's T is a *slice* of the global sequence (pass
         ``axis_index('seq') * T_local + arange(T_local)``).
+
+        ``targets`` ([B, T] int32) switches the head to the fused
+        streaming cross entropy (:func:`mpit_tpu.ops.lm_head.lm_head_xent`)
+        and returns **per-token losses** [B, T] float32 instead of logits
+        — the [B, T, vocab] f32 logits array is never materialized.
+        Matmul operand dtype follows ``cfg.head_dtype`` on both paths.
         """
         cfg = self.cfg
         wte = self.param(
@@ -157,6 +163,12 @@ class GPT2(nn.Module):
                 jnp.float32,
             )
         )
+        if targets is not None:
+            from mpit_tpu.ops.lm_head import lm_head_xent
+
+            return lm_head_xent(
+                x, head, targets, compute_dtype=cfg.head_dtype
+            )
         logits = jnp.einsum(
             "btd,vd->btv",
             x.astype(cfg.head_dtype),
@@ -173,3 +185,11 @@ class GPT2(nn.Module):
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         return -jnp.mean(ll)
+
+    @staticmethod
+    def fused_loss_fn(model: "GPT2", params, tokens):
+        """Mean next-token xent via the fused head: tokens [B, T+1]."""
+        losses = model.apply(
+            {"params": params}, tokens[:, :-1], targets=tokens[:, 1:]
+        )
+        return jnp.mean(losses)

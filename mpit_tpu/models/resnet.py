@@ -27,8 +27,10 @@ class Bottleneck(nn.Module):
     norm: Any = nn.BatchNorm
 
     @nn.compact
-    def __call__(self, x):
-        norm = partial(self.norm, use_running_average=False, dtype=jnp.float32)
+    def __call__(self, x, *, train: bool = True):
+        norm = partial(
+            self.norm, use_running_average=not train, dtype=jnp.float32
+        )
         residual = x
         y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
         y = nn.relu(norm()(y))
@@ -54,14 +56,18 @@ class ResNet50(nn.Module):
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, train: bool = True):
+        """``train=True``: BN uses batch statistics and updates the
+        ``batch_stats`` collection (apply with ``mutable=['batch_stats']``).
+        ``train=False``: BN normalizes with the running averages — the
+        inference-mode path eval metrics must use (round-1 advisor)."""
         x = x.astype(self.dtype)
         x = nn.Conv(
             64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
             use_bias=False, dtype=self.dtype,
         )(x)
         x = nn.relu(
-            nn.BatchNorm(use_running_average=False, dtype=jnp.float32)(x)
+            nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(x)
         )
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         for stage, n_blocks in enumerate(self.stage_sizes):
@@ -69,7 +75,7 @@ class ResNet50(nn.Module):
                 strides = 2 if stage > 0 and block == 0 else 1
                 x = Bottleneck(
                     64 * 2**stage, strides=strides, dtype=self.dtype
-                )(x)
+                )(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
         return x

@@ -11,6 +11,9 @@ hand-scheduled TPU kernels below the XLA tier:
   (online softmax; never materializes the [T, T] score matrix) with a
   Flash-2 custom-VJP backward, the GPT-2 inner kernel and the per-shard
   block under ring attention.
+- :mod:`mpit_tpu.ops.lm_head` — fused LM-head cross entropy (the same
+  online-logsumexp trick applied over the vocabulary axis; never
+  materializes the [B, T, vocab] f32 logits).
 
 Every kernel has an ``interpret`` path so its semantics are testable on
 the CPU fake mesh (SURVEY.md §6 "race detection" row), and an XLA
@@ -23,6 +26,7 @@ from mpit_tpu.ops.flash_attention import (
     merge_attention,
     reference_attention,
 )
+from mpit_tpu.ops.lm_head import lm_head_xent
 from mpit_tpu.ops.ring_allreduce import ring_allreduce
 
 __all__ = [
@@ -30,5 +34,6 @@ __all__ = [
     "flash_attention_block",
     "merge_attention",
     "reference_attention",
+    "lm_head_xent",
     "ring_allreduce",
 ]

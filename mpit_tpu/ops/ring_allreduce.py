@@ -187,6 +187,11 @@ def ring_allreduce(x, axis: str, *, interpret: bool = False):
     if not interpret and jax.devices()[0].platform != "tpu":
         return lax.psum(x, axis)
     p = lax.axis_size(axis)
+    if p == 1:
+        # Degenerate ring: x already equals the sum. Entering the kernel
+        # would deadlock — both phase loops are empty (no capacity tokens
+        # ever signaled) while the drain waits on two of them.
+        return x
     flat = jnp.ravel(x)
     n = flat.shape[0]
     sublane = 16 if x.dtype == jnp.bfloat16 else _SUBLANE

@@ -15,8 +15,12 @@ TPU-native redesign:
   cross-replica sharding of any gradient transformation — reduce-scatter
   grads → update the local shard of params+state → all-gather params
   (cf. arXiv:2004.13336, PAPERS.md).
+- :mod:`mpit_tpu.opt.schedules` — learning-rate schedules (warmup /
+  cosine / staircase) consumed by the goo family as ``step -> lr``
+  callables (round 2; the reference used hand-tuned constants).
 """
 
+from mpit_tpu.opt import schedules
 from mpit_tpu.opt.goo import GooState, elastic_average, goo, goo_adam
 from mpit_tpu.opt.sharded import sharded, sharded_init, sharded_update
 
@@ -25,6 +29,7 @@ __all__ = [
     "goo_adam",
     "GooState",
     "elastic_average",
+    "schedules",
     "sharded",
     "sharded_init",
     "sharded_update",

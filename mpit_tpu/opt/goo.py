@@ -25,18 +25,27 @@ Design choices:
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
 
+# A learning rate is a constant or a schedule ``step -> lr`` (round-2:
+# the reference-era workloads need warmup — BENCHMARKS.md documents
+# AlexNet diverging at the classic lr 0.01 without it).
+LearningRate = float | Callable
+
 
 class GooState(NamedTuple):
-    """Momentum buffers for :func:`goo` (empty tuple when momentum=0)."""
+    """Momentum buffers for :func:`goo` (empty tuple when momentum=0);
+    ``count`` is the schedule step (empty tuple for a constant lr, so the
+    constant-lr state tree is unchanged from round 1 — checkpoints and
+    parity tests see the same structure)."""
 
     momentum: optax.Updates
+    count: jax.Array | tuple = ()
 
 
 class ElasticState(NamedTuple):
@@ -46,7 +55,7 @@ class ElasticState(NamedTuple):
 
 
 def goo(
-    lr: float,
+    lr: LearningRate,
     momentum: float = 0.0,
     *,
     nesterov: bool = False,
@@ -62,6 +71,11 @@ def goo(
         g ← g + momentum·b   if nesterov else b
         p ← p − lr·g
 
+    ``lr`` may be a constant or a schedule ``step -> lr`` (see
+    :mod:`mpit_tpu.opt.schedules`); the schedule step is tracked in
+    ``GooState.count`` — a replicated scalar, so goo stays elementwise
+    and composes with the ZeRO-1 wrapper (``opt.sharded`` precondition).
+
     Returns an optax ``GradientTransformation`` producing *updates*
     (``−lr·g``) to be applied with ``optax.apply_updates``.
 
@@ -73,20 +87,26 @@ def goo(
             "nesterov requires momentum > 0 and dampening == 0 "
             "(matching torch.optim.SGD's guard)"
         )
+    scheduled = callable(lr)
 
     def init(params):
+        count = jnp.zeros((), jnp.int32) if scheduled else ()
         if momentum == 0.0:
-            return GooState(momentum=())
-        return GooState(momentum=jax.tree.map(jnp.zeros_like, params))
+            return GooState(momentum=(), count=count)
+        return GooState(
+            momentum=jax.tree.map(jnp.zeros_like, params), count=count
+        )
 
     def update(grads, state, params=None):
+        lr_t = lr(state.count) if scheduled else lr
+        new_count = state.count + 1 if scheduled else ()
         if weight_decay != 0.0:
             if params is None:
                 raise ValueError("goo(weight_decay != 0) requires params")
             grads = jax.tree.map(lambda g, p: g + weight_decay * p, grads, params)
         if momentum == 0.0:
-            updates = jax.tree.map(lambda g: -lr * g, grads)
-            return updates, state
+            updates = jax.tree.map(lambda g: -lr_t * g, grads)
+            return updates, GooState(momentum=state.momentum, count=new_count)
 
         # Buffers seed at zero, so the first step gives b = (1-damp)·g.
         # Torch special-cases the first step to b = g; with dampening=0
@@ -102,21 +122,22 @@ def goo(
             step = jax.tree.map(lambda g, b: g + momentum * b, grads, buf)
         else:
             step = buf
-        updates = jax.tree.map(lambda s: -lr * s, step)
-        return updates, GooState(momentum=buf)
+        updates = jax.tree.map(lambda s: -lr_t * s, step)
+        return updates, GooState(momentum=buf, count=new_count)
 
     return optax.GradientTransformation(init, update)
 
 
 def goo_adam(
-    lr: float,
+    lr: LearningRate,
     b1: float = 0.9,
     b2: float = 0.999,
     eps: float = 1e-8,
     weight_decay: float = 0.0,
 ) -> optax.GradientTransformation:
     """Adam(W) spelled as a goo rule — not in the reference (its goo is SGD
-    family; SURVEY.md §3.1 A3) but required by the GPT-2 stretch config."""
+    family; SURVEY.md §3.1 A3) but required by the GPT-2 stretch config.
+    ``lr`` may be a schedule (optax consumes callables natively)."""
     if weight_decay:
         return optax.adamw(lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
     return optax.adam(lr, b1=b1, b2=b2, eps=eps)

@@ -1,0 +1,91 @@
+"""Learning-rate schedules for the goo family.
+
+The reference trains with constant learning rates hand-tuned per run
+(Lua ``opt.lr``; SURVEY.md §6 config row). That is exactly what fails at
+modern batch sizes: BENCHMARKS.md (round 1) documents AlexNet diverging
+from scratch at the classic lr 0.01 — dead-ReLU collapse in the first
+steps — which a linear warmup prevents. Round 2 therefore adds the three
+standard shapes as ``step -> lr`` callables (consumed by ``goo``/
+``goo_adam`` and by optax natively); thin wrappers over optax schedules
+so the math is the battle-tested implementation.
+
+Selection from workload configs goes through :func:`from_config` (the
+``--schedule warmup_cosine --warmup-steps 200`` flags of
+``asyncsgd.config.TrainConfig``).
+"""
+
+from __future__ import annotations
+
+import optax
+
+from mpit_tpu.opt.goo import LearningRate
+
+
+def warmup_constant(lr: float, warmup_steps: int) -> LearningRate:
+    """Linear 0 → lr over ``warmup_steps``, then constant."""
+    if warmup_steps <= 0:
+        return lr
+    return optax.schedules.linear_schedule(0.0, lr, warmup_steps)
+
+
+def warmup_cosine(
+    lr: float,
+    warmup_steps: int,
+    total_steps: int,
+    *,
+    end_scale: float = 0.0,
+) -> LearningRate:
+    """Linear warmup to ``lr`` then cosine decay to ``lr * end_scale``
+    by ``total_steps`` — the standard transformer/convnet schedule."""
+    return optax.schedules.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=lr,
+        warmup_steps=max(warmup_steps, 1),
+        decay_steps=max(total_steps, warmup_steps + 1),
+        end_value=lr * end_scale,
+    )
+
+
+def step_decay(
+    lr: float, every: int, factor: float = 0.1
+) -> LearningRate:
+    """Multiply lr by ``factor`` every ``every`` steps — the classic
+    ImageNet staircase (AlexNet/ResNet era)."""
+
+    def schedule(count):
+        return lr * factor ** (count // every)
+
+    return schedule
+
+
+def from_config(cfg, total_steps: int | None = None) -> LearningRate:
+    """Build the lr (constant or schedule) from a ``TrainConfig``.
+
+    Recognized ``cfg.schedule`` values: ``""`` (constant),
+    ``"warmup"``, ``"warmup_cosine"``, ``"step"``.
+    """
+    name = getattr(cfg, "schedule", "") or ""
+    total = total_steps if total_steps is not None else cfg.steps
+    if name == "":
+        return cfg.lr
+    if name == "warmup":
+        return warmup_constant(cfg.lr, cfg.warmup_steps)
+    if name == "warmup_cosine":
+        return warmup_cosine(
+            cfg.lr, cfg.warmup_steps, total, end_scale=cfg.lr_end_scale
+        )
+    if name == "step":
+        if cfg.decay_every <= 0:
+            raise ValueError("--schedule step requires --decay-every > 0")
+        base = step_decay(cfg.lr, cfg.decay_every, cfg.decay_factor)
+        if cfg.warmup_steps > 0:
+            warm = warmup_constant(cfg.lr, cfg.warmup_steps)
+            return optax.schedules.join_schedules(
+                [warm, lambda c: base(c + cfg.warmup_steps)],
+                [cfg.warmup_steps],
+            )
+        return base
+    raise ValueError(
+        f"unknown schedule {name!r} (expected '', 'warmup', "
+        "'warmup_cosine', or 'step')"
+    )

@@ -140,12 +140,12 @@ def make_gpt2_cp_train_step(
         local_params = C.vary(state.params, axes)
 
         def loss_fn(p):
-            logits = model.apply({"params": p}, tokens, positions)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            # Fused streaming LM-head xent (ops/lm_head.py): per-token
+            # losses [b, t_local] without materializing local logits.
+            losses = model.apply({"params": p}, tokens, positions, targets)
             # Local weighted sum over the GLOBAL count: summing the per-
             # device grads then reproduces the exact global-mean gradient.
-            return -jnp.sum(ll * mask) / count
+            return jnp.sum(losses * mask) / count
 
         loss_local, grads = jax.value_and_grad(loss_fn)(local_params)
         grads = jax.tree.map(lambda g: lax.psum(g, seq_axis), grads)

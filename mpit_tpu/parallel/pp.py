@@ -23,12 +23,21 @@ Parameter/gradient geometry (the part worth reading):
 - Weight tying would put one parameter (wte) in two categories at once,
   which per-leaf combine cannot express — the pp tier requires
   ``GPT2Config.tie_head=False`` (enforced).
-- Optimizer state mirrors the local params per leaf (stage-state leaves
-  sharded ``P('pipe')``). The flat-vector ZeRO-1 wrapper is NOT composed
-  here: raveling pipe-varying stage leaves together with pipe-invariant
-  embedding/head leaves into one flat shard erases the per-leaf
-  placement types — sharded-state PP is future work, so ``zero1`` is
-  rejected rather than silently wrong.
+- Optimizer state: with ``zero1=False`` it mirrors the local params per
+  leaf (stage-state leaves sharded ``P('pipe')``). With ``zero1=True``
+  (the north-star "goo state sharded across chips", BASELINE.json) the
+  tree is split into its two placement groups and each gets its own
+  flat-vector ZeRO-1 wrapper over ``data``: **stage leaves** shard their
+  state across the data replicas *within each pipe group* (state spec
+  ``P(('pipe','data'))`` — different content per pipe coordinate, 1/N_d
+  of it per data coordinate), while the pipe-invariant **rest** leaves
+  (embedding/head/final-LN) use exactly the pure-DP path (``P('data')``,
+  replicated over pipe). The round-1 objection — one flat ravel erasing
+  per-leaf placement — is dissolved by raveling per *group*, inside
+  which placement is uniform. Per-device optimizer memory drops by the
+  data-axis size vs ``zero1=False``; the reduce-scatter carries the
+  data-mean, so trajectories match the unsharded path exactly
+  (tests/test_parallel.py).
 """
 
 from __future__ import annotations
@@ -39,8 +48,11 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from mpit_tpu import opt as gopt
 from mpit_tpu.comm import collectives as C
 from mpit_tpu.models.gpt2 import Block, GPT2Config
+from mpit_tpu.ops.lm_head import lm_head_xent
+from mpit_tpu.opt.sharded import state_partition_specs
 from mpit_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
 from mpit_tpu.train.step import TrainState
 
@@ -89,12 +101,12 @@ def make_gpt2_pp_train_step(
             "pipeline parallelism requires an untied LM head: "
             "GPT2Config(tie_head=False) — see parallel.pp docstring"
         )
-    if zero1:
-        raise NotImplementedError(
-            "ZeRO-1 does not compose with the pp tier yet (flat sharding "
-            "erases per-leaf pipe placement; see parallel.pp docstring)"
-        )
     n_pipe = world.axis_size(pipe_axis)
+    n_data = world.axis_size(data_axis)
+    # One stateless ZeRO-1 wrapper serves both placement groups (module
+    # docstring): each group's leaves share one placement, so the flat
+    # ravel is sound within it; the per-group state lives in opt_state.
+    stx = gopt.sharded(tx, data_axis) if zero1 else None
     if cfg.num_layers % n_pipe:
         raise ValueError(
             f"num_layers ({cfg.num_layers}) must divide by pipe={n_pipe}"
@@ -130,6 +142,18 @@ def make_gpt2_pp_train_step(
 
     def _opt_specs(split_params):
         local = jax.eval_shape(_local_view, split_params)
+        if zero1:
+            # Flat sharded-state specs per group: stage-state shards live
+            # per (pipe, data) coordinate; rest-state shards per data
+            # coordinate, replicated over pipe.
+            stage_specs = jax.tree.map(
+                lambda s: P((pipe_axis, data_axis)) if s == P(data_axis) else s,
+                state_partition_specs(tx, local["stages"], n_data, data_axis),
+            )
+            rest_specs = state_partition_specs(
+                tx, local["rest"], n_data, data_axis
+            )
+            return {"stages": stage_specs, "rest": rest_specs}
         shapes = jax.eval_shape(tx.init, local)
 
         def spec_for(path, leaf):
@@ -152,7 +176,14 @@ def make_gpt2_pp_train_step(
         )
 
     def _per_device_init(split):
-        opt_state = tx.init(_local_view(split))
+        local = _local_view(split)
+        if zero1:
+            opt_state = {
+                "stages": stx.init(local["stages"]),
+                "rest": stx.init(local["rest"]),
+            }
+        else:
+            opt_state = tx.init(local)
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=split,
@@ -169,20 +200,14 @@ def make_gpt2_pp_train_step(
         )
         return jax.jit(f)(split_params)
 
-    def _apply_head(rest, h):
+    def _final_norm(rest, h):
         # flax nn.LayerNorm semantics (f32 compute, eps 1e-6), hand-rolled
         # because the head runs on the raw pipeline output outside a module.
         h = h.astype(jnp.float32)
         mu = jnp.mean(h, axis=-1, keepdims=True)
         var = jnp.var(h, axis=-1, keepdims=True)
         hn = (h - mu) / jnp.sqrt(var + 1e-6)
-        hn = hn * rest["ln_f"]["scale"] + rest["ln_f"]["bias"]
-        return jnp.einsum(
-            "btd,vd->btv",
-            hn.astype(cfg.head_dtype),
-            rest["head"].astype(cfg.head_dtype),
-            preferred_element_type=jnp.float32,
-        )
+        return hn * rest["ln_f"]["scale"] + rest["ln_f"]["bias"]
 
     def _per_device_step(state: TrainState, batch):
         tokens = batch["tokens"]  # [b_local, T+1], replicated over pipe
@@ -207,10 +232,15 @@ def make_gpt2_pp_train_step(
             xm = x.reshape(m, b // m, t, x.shape[-1])
             ym = spmd_pipeline(stage_fn, local_stage, xm, axis=pipe_axis)
             h = ym.reshape(b, t, x.shape[-1])
-            logits = _apply_head(rest, h)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-            ll = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
-            return -jnp.mean(ll)
+            # Fused streaming LM-head xent (ops/lm_head.py): the local
+            # [b, t, vocab] f32 logits are never materialized.
+            losses = lm_head_xent(
+                _final_norm(rest, h),
+                rest["head"],
+                targets,
+                compute_dtype=cfg.head_dtype,
+            )
+            return jnp.mean(losses)
 
         local = C.vary(state.params, axes)
         loss, grads = jax.value_and_grad(loss_fn)(local)
@@ -226,14 +256,30 @@ def make_gpt2_pp_train_step(
             "stages": jax.tree.map(lambda l: l[0], grads["stages"]),
             "rest": g_rest,
         }
-        local_grads = jax.tree.map(
-            lambda g: lax.pmean(g, data_axis), local_grads
-        )
 
         local_params = _local_view(state.params)
-        updates, opt_state = tx.update(
-            local_grads, state.opt_state, local_params
-        )
+        if zero1:
+            # Per-group reduce-scatter/update/all-gather over data (the
+            # data-mean rides the reduce-scatter; no separate pmean).
+            u_stage, st_stage = stx.update(
+                local_grads["stages"],
+                state.opt_state["stages"],
+                local_params["stages"],
+            )
+            u_rest, st_rest = stx.update(
+                local_grads["rest"],
+                state.opt_state["rest"],
+                local_params["rest"],
+            )
+            updates = {"stages": u_stage, "rest": u_rest}
+            opt_state = {"stages": st_stage, "rest": st_rest}
+        else:
+            local_grads = jax.tree.map(
+                lambda g: lax.pmean(g, data_axis), local_grads
+            )
+            updates, opt_state = tx.update(
+                local_grads, state.opt_state, local_params
+            )
         new_local = optax.apply_updates(local_params, updates)
         new_params = {
             "stages": jax.tree.map(lambda l: l[None], new_local["stages"]),

@@ -108,6 +108,7 @@ def make_train_step(
     zero1: bool = True,
     stateful: bool = False,
     donate: bool = True,
+    scan_steps: int | None = None,
 ):
     """Build ``(init_fn, step_fn, state_specs)`` for SPMD data-parallel
     training over ``world``'s ``axis``.
@@ -123,6 +124,14 @@ def make_train_step(
       zero1: shard optimizer state across ``axis`` (reduce-scatter/
         all-gather path); False = replicated state + plain pmean DP.
       donate: donate the input state buffers to the step (in-place update).
+      scan_steps: when set, ``step_fn`` consumes a *stacked* batch (every
+        leaf carries a leading ``[scan_steps, ...]`` axis) and runs that
+        many optimizer steps inside one compiled call via ``lax.scan`` —
+        one host→device dispatch per K steps instead of per step. This is
+        the TPU-native answer to dispatch latency (no host round-trip
+        between steps; on this environment's tunneled chip a dispatch
+        costs ~10–15 ms, comparable to a whole step). Metrics are those
+        of the **last** scanned step.
 
     Returns:
       ``init_fn(params, extra=()) -> TrainState`` (host-level),
@@ -170,11 +179,19 @@ def make_train_step(
         )
         return new_state, metrics
 
+    def _per_device_multi(state: TrainState, stacked):
+        new_state, metrics = lax.scan(_per_device_step, state, stacked)
+        return new_state, jax.tree.map(lambda m: m[-1], metrics)
+
     def build_step(params, extra=()):
         specs = state_specs(params, extra)
+        if scan_steps:
+            body, batch_spec = _per_device_multi, P(None, axis)
+        else:
+            body, batch_spec = _per_device_step, P(axis)
         f = world.shard_map(
-            _per_device_step,
-            in_specs=(specs, P(axis)),
+            body,
+            in_specs=(specs, batch_spec),
             out_specs=(specs, P()),
         )
         return jax.jit(f, donate_argnums=(0,) if donate else ())

@@ -211,3 +211,91 @@ class TestSharded:
         updates, state = f(local_grads, state, params)
         expect = -(n * (n + 1) / 2)
         np.testing.assert_allclose(np.asarray(updates["w"]), np.full(4, expect))
+
+
+class TestSchedules:
+    """opt/schedules.py + scheduled goo (round 2)."""
+
+    def test_goo_schedule_matches_manual_lr_sequence(self):
+        import numpy as np
+        from mpit_tpu import opt as gopt
+
+        lrs = [0.1, 0.05, 0.025]
+        tx = gopt.goo(lambda c: jnp.asarray(lrs)[c], momentum=0.9)
+        params = {"w": jnp.asarray([1.0, -2.0])}
+        state = tx.init(params)
+        manual_params = params
+        manual_buf = jnp.zeros(2)
+        g = {"w": jnp.asarray([0.5, 0.25])}
+        for lr in lrs:
+            up, state = tx.update(g, state, params)
+            params = optax.apply_updates(params, up)
+            manual_buf = 0.9 * manual_buf + g["w"]
+            manual_params = {"w": manual_params["w"] - lr * manual_buf}
+            np.testing.assert_allclose(
+                np.asarray(params["w"]), np.asarray(manual_params["w"]),
+                rtol=1e-6,
+            )
+        assert int(state.count) == 3
+
+    def test_warmup_cosine_shape(self):
+        from mpit_tpu.opt import schedules
+
+        s = schedules.warmup_cosine(0.01, 10, 100)
+        assert float(s(0)) == 0.0
+        assert abs(float(s(10)) - 0.01) < 1e-9
+        assert float(s(5)) == pytest.approx(0.005, rel=1e-6)
+        assert float(s(100)) < 1e-6
+
+    def test_step_decay_staircase(self):
+        from mpit_tpu.opt import schedules
+
+        s = schedules.step_decay(0.1, every=30, factor=0.1)
+        assert float(s(0)) == pytest.approx(0.1)
+        assert float(s(29)) == pytest.approx(0.1)
+        assert float(s(30)) == pytest.approx(0.01)
+        assert float(s(60)) == pytest.approx(0.001, rel=1e-6)
+
+    def test_from_config_selects(self):
+        from mpit_tpu.asyncsgd.config import TrainConfig
+        from mpit_tpu.opt import schedules
+
+        assert schedules.from_config(TrainConfig(lr=0.3)) == 0.3
+        cfg = TrainConfig(lr=0.01, schedule="warmup", warmup_steps=20)
+        s = schedules.from_config(cfg)
+        assert float(s(0)) == 0.0 and float(s(20)) == pytest.approx(0.01)
+        with pytest.raises(ValueError, match="decay-every"):
+            schedules.from_config(TrainConfig(schedule="step"))
+        with pytest.raises(ValueError, match="unknown schedule"):
+            schedules.from_config(TrainConfig(schedule="bogus"))
+
+    def test_scheduled_goo_composes_with_zero1(self, world8):
+        """The schedule count is a replicated scalar: sharded(goo(sched))
+        must agree with unsharded goo(sched) trajectories."""
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+        from mpit_tpu import opt as gopt
+        from mpit_tpu.opt.sharded import state_partition_specs
+
+        sched = lambda c: 0.1 * 0.5 ** c.astype(jnp.float32)
+        params = {"w": jnp.arange(12.0), "b": jnp.ones(3)}
+        grads = {"w": jnp.ones(12) * 0.2, "b": jnp.ones(3) * 0.1}
+
+        ref_tx = gopt.goo(sched, momentum=0.9)
+        ref_state = ref_tx.init(params)
+        ref_p = params
+        state = gopt.sharded_init(world8, gopt.goo(sched, momentum=0.9), params)
+        tx = gopt.goo(sched, momentum=0.9)
+        p = params
+        for _ in range(3):
+            up, state = gopt.sharded_update(world8, tx, grads, state, p)
+            p = optax.apply_updates(p, up)
+            rup, ref_state = ref_tx.update(grads, ref_state, ref_p)
+            ref_p = optax.apply_updates(ref_p, rup)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            p,
+            ref_p,
+        )
