@@ -42,7 +42,8 @@ class GPT2TrainConfig(TrainConfig):
     remat: bool = False
     flash: bool = False  # Pallas flash-attention inner kernel (TPU)
     ulysses: bool = False  # cp tier: all-to-all Ulysses instead of the ring
-    microbatches: int = 4  # pp tier: GPipe microbatch count
+    microbatches: int = 4  # pp tier: microbatch count
+    pp_schedule: str = "gpipe"  # pp tier: "gpipe" (AD oracle) | "1f1b"
     lr: float = 3e-4
     batch_size: int = 8
     fsdp_axis: str = ""  # e.g. "data" to compose ZeRO-3 with TP
@@ -140,7 +141,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         pp_model = GPT2(mcfg_pp)
         init_fn, step_fn, _ = make_gpt2_pp_train_step(
             mcfg_pp, tx, world, num_microbatches=cfg.microbatches,
-            zero1=cfg.zero1,
+            zero1=cfg.zero1, schedule=cfg.pp_schedule,
         )
 
         def pp_init():
@@ -157,7 +158,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
                 world, {"tokens": np.asarray(b["tokens"])[:, : cfg.seq_len + 1]}
             ),
         )
-        tier = f"pp-gpipe-m{cfg.microbatches}"
+        tier = f"pp-{cfg.pp_schedule}-m{cfg.microbatches}"
     elif mesh_shape and "seq" in mesh_shape:
         # Context-parallel tier: sequence sharded over the seq axis, ring
         # attention inside, cross-shard next-token targets (parallel.cp).

@@ -282,8 +282,17 @@ def run_parity_classifier(cfg: TrainConfig, model, dataset) -> dict:
 
         return jax.value_and_grad(f)(flat)
 
-    server_tx = gopt.goo(cfg.lr, cfg.momentum, weight_decay=cfg.weight_decay)
-    local_tx = gopt.goo(cfg.lr, cfg.momentum, weight_decay=cfg.weight_decay)
+    # The parity actors honor the same lr schedule flags as the SPMD path
+    # (the server's goo owns the schedule step, as the reference's pserver
+    # owned the canonical optimizer state).
+    server_tx = gopt.goo(
+        gopt.schedules.from_config(cfg), cfg.momentum,
+        weight_decay=cfg.weight_decay,
+    )
+    local_tx = gopt.goo(
+        gopt.schedules.from_config(cfg), cfg.momentum,
+        weight_decay=cfg.weight_decay,
+    )
 
     @jax.jit
     def local_step(flat, opt_state, batch):

@@ -40,6 +40,14 @@ from mpit_tpu.comm import collectives as C
 _NEG_BIG = -1e30  # "-inf" that survives subtraction without NaNs
 
 
+def _reduce_to_vma(x, primal):
+    """psum ``x`` over any mesh axes it varies over but ``primal`` doesn't."""
+    have = set(getattr(jax.typeof(x), "vma", frozenset()) or ())
+    want = set(getattr(jax.typeof(primal), "vma", frozenset()) or ())
+    extra = tuple(sorted(have - want))
+    return lax.psum(x, extra) if extra else x
+
+
 def _match_vma(x, *refs):
     """Retype ``x`` to carry the union of ``refs``' device-varying axes.
 
@@ -135,7 +143,16 @@ def _xent2d_bwd(vocab, block, compute_dtype, res, ct):
     dh0 = _match_vma(jnp.zeros(h.shape, jnp.float32), h, head, targets, ct)
     dh, dhead_blocks = lax.scan(tick, dh0, (head_blocks, offsets))
     dhead = dhead_blocks.reshape(head.shape)
-    return dh.astype(h.dtype), dhead.astype(head.dtype), None
+    # Custom-VJP contract: each cotangent must carry exactly its primal's
+    # varying type. When the cotangent picked up axes the primal doesn't
+    # vary over (e.g. replicated head under a varying loss), the correct
+    # cotangent is the psum over those axes — the same reduction VMA-aware
+    # AD inserts automatically for ordinary ops.
+    return (
+        _reduce_to_vma(dh, h).astype(h.dtype),
+        _reduce_to_vma(dhead, head).astype(head.dtype),
+        None,
+    )
 
 
 _xent2d.defvjp(_xent2d_fwd, _xent2d_bwd)

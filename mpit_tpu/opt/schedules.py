@@ -80,9 +80,10 @@ def from_config(cfg, total_steps: int | None = None) -> LearningRate:
         base = step_decay(cfg.lr, cfg.decay_every, cfg.decay_factor)
         if cfg.warmup_steps > 0:
             warm = warmup_constant(cfg.lr, cfg.warmup_steps)
+            # join_schedules already rebases the count past each boundary,
+            # so the staircase starts fresh (at peak lr) after warmup.
             return optax.schedules.join_schedules(
-                [warm, lambda c: base(c + cfg.warmup_steps)],
-                [cfg.warmup_steps],
+                [warm, base], [cfg.warmup_steps]
             )
         return base
     raise ValueError(

@@ -35,7 +35,11 @@ from mpit_tpu.parallel.tp import (
     param_partition_specs,
     make_pjit_train_step,
 )
-from mpit_tpu.parallel.pipeline import spmd_pipeline
+from mpit_tpu.parallel.pipeline import (
+    live_microbatch_slots,
+    spmd_pipeline,
+    spmd_pipeline_1f1b,
+)
 from mpit_tpu.parallel.pp import make_gpt2_pp_train_step, split_gpt2_params
 from mpit_tpu.parallel.megatron import (
     column_parallel_dense,
@@ -56,6 +60,8 @@ __all__ = [
     "param_partition_specs",
     "make_pjit_train_step",
     "spmd_pipeline",
+    "spmd_pipeline_1f1b",
+    "live_microbatch_slots",
     "column_parallel_dense",
     "row_parallel_dense",
     "tp_mlp",

@@ -34,6 +34,7 @@ def spmd_pipeline(
     microbatches,
     *,
     axis: str = "pipe",
+    broadcast_outputs: bool = True,
 ):
     """Run ``microbatches`` through P pipeline stages; call inside shard_map.
 
@@ -46,8 +47,20 @@ def spmd_pipeline(
         leading dim of 1), it is squeezed automatically.
       microbatches: [M, ...] — the batch pre-split into M microbatches,
         replicated across the axis.
+      broadcast_outputs: replicate the result to every device (the
+        round-1 behavior). **Pass False when the consumer of the outputs
+        is differentiated w.r.t. pipe-VARYING parameters** (e.g. an LM
+        head the caller ``vary()``-ed): a varying consumer makes the
+        output cotangent pipe-varying, and the AD transpose of the
+        broadcast's psum then SUMS that cotangent over the axis — every
+        stage grad silently scales by P (found round 2; adam's scale
+        invariance had masked it). With False, only the last stage's
+        outputs are real (zeros elsewhere) — mask the loss to the last
+        stage and combine grads with psum over the axis, as
+        ``parallel.pp`` does.
 
-    Returns [M, ...] outputs, replicated (broadcast from the last stage).
+    Returns [M, ...] outputs — replicated when ``broadcast_outputs``,
+    else real on the last stage only.
     """
     n = lax.axis_size(axis)
     i = lax.axis_index(axis)
@@ -86,6 +99,8 @@ def spmd_pipeline(
     (_, outputs), _ = lax.scan(
         tick, (state, outputs), jnp.arange(m + n - 1)
     )
+    if not broadcast_outputs:
+        return outputs
     # Only the last stage holds real outputs; replicate them.
     return C.broadcast(outputs, axis, root=n - 1)
 
@@ -94,3 +109,187 @@ def stack_stage_params(per_stage_params: list):
     """Stack per-stage param trees on a new leading [P, ...] axis — the
     layout :func:`spmd_pipeline` expects via in_specs ``P('pipe')``."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def live_microbatch_slots(n_stages: int) -> int:
+    """Peak stage-input activations held per device under
+    :func:`spmd_pipeline_1f1b`: ``2·P``, independent of the microbatch
+    count M (the 1F1B memory bound; GPipe-through-AD holds residuals for
+    all ``M + P - 1`` forward ticks)."""
+    return 2 * n_stages
+
+
+def spmd_pipeline_1f1b(
+    stage_fn: Callable,
+    embed_fn: Callable,
+    head_loss_fn: Callable,
+    params,
+    inputs,
+    targets,
+    *,
+    axis: str = "pipe",
+):
+    """One-fwd-one-bwd pipelined **training step core**: loss AND grads.
+
+    Where :func:`spmd_pipeline` is a forward transform differentiated by
+    AD (GPipe: all M forwards, then the reverse pipeline — M in-flight
+    microbatch residuals), this schedule interleaves each microbatch's
+    backward as soon as its cotangent exists, so a device only ever holds
+    ``2·P`` stage *inputs* (:func:`live_microbatch_slots`) and
+    rematerializes the stage forward inside the backward tick
+    (``jax.vjp``). That requires owning the backward: the per-microbatch
+    loss/head runs *inside* the schedule on the last stage, and the
+    function returns gradients directly instead of being differentiated.
+
+    Schedule (eager-forward 1F1B, SPMD lockstep): stage ``i`` runs
+    forward of microbatch ``k`` at tick ``i + k`` and backward at tick
+    ``2P − 1 − i + k``; activations hop ``i → i+1`` and cotangents
+    ``i+1 → i`` by one ``ppermute`` each per tick; total ticks
+    ``M + 2P − 1``. Every device executes every tick's full body with
+    validity masks — under SPMD lockstep divergent control flow costs
+    both branches anyway, which is also why the eager variant (F and B
+    in the same tick) is chosen over the strict one-op-per-tick
+    alternation: half the ticks at the same per-tick cost, still an
+    O(P) memory bound (``2P`` vs strict ``P`` slots).
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` with ``y.shape == x.shape``.
+      embed_fn: ``(embed_params, mb_input) -> x`` — stage-0 ingestion
+        (e.g. token+position embedding).
+      head_loss_fn: ``(head_params, y, mb_target) -> scalar`` — last-stage
+        head + per-microbatch mean loss.
+      params: ``{"stages": local stage params, "embed": ..., "head": ...}``
+        (stages per-device via ``P(axis)`` in_specs; embed/head replicated).
+      inputs: ``[M, ...]`` microbatched inputs (replicated over the axis).
+      targets: ``[M, ...]`` microbatched targets.
+
+    Returns:
+      ``(loss, grads)``: scalar mean loss (over microbatches, replicated)
+      and a grads tree in the same layout as ``params`` — stage grads are
+      LOCAL (complete per device); embed grads live on stage 0 only and
+      head grads on stage P−1 only (zeros elsewhere): **combine with
+      ``psum`` over the axis**, unlike the GPipe tier's mixed
+      psum/pmean (`parallel.pp` handles both).
+    """
+    n = lax.axis_size(axis)
+    i = lax.axis_index(axis)
+    m = inputs.shape[0]
+    slots = live_microbatch_slots(n)
+
+    def maybe_squeeze(leaf):
+        return leaf[0] if leaf.ndim >= 1 and leaf.shape[0] == 1 else leaf
+
+    stage_params = jax.tree.map(maybe_squeeze, params["stages"])
+    # Embed/head params MUST be typed device-varying over the pipe axis
+    # before the per-tick vjps: differentiating w.r.t. a *replicated*
+    # value makes VMA-aware AD auto-psum its cotangent over the axis —
+    # which here would fold the other stages' masked-out garbage
+    # contributions into every device's grad BEFORE the validity masks
+    # apply (observed: head grads polluted by exactly that psum; stage
+    # params were already varying via their P(axis) in_specs, which is
+    # why stage grads were exact). vary() is idempotent for callers that
+    # already varied them.
+    embed_params, head_params = C.vary(
+        (params["embed"], params["head"]), axis
+    )
+
+    x_shape = jax.eval_shape(embed_fn, embed_params, inputs[0])
+    zero_x = jnp.zeros(x_shape.shape, x_shape.dtype)
+
+    g_zero = jax.tree.map(
+        jnp.zeros_like,
+        {"stages": stage_params, "embed": embed_params, "head": head_params},
+    )
+    # The carry must be typed varying over the pipe axis AND any axis the
+    # operands already vary over (e.g. `data` when the tier runs inside a
+    # data x pipe shard_map) — scan requires carry-in/out type equality.
+    vma: set = {axis}
+    for leaf in jax.tree.leaves((inputs, targets, stage_params)):
+        vma |= set(getattr(jax.typeof(leaf), "vma", frozenset()) or ())
+    init = C.vary(
+        (
+            zero_x,  # activation arriving from the previous stage
+            jnp.zeros_like(zero_x),  # cotangent arriving from the next stage
+            jnp.zeros((slots, *x_shape.shape), x_shape.dtype),  # input ring
+            g_zero,
+            jnp.zeros((), jnp.float32),  # loss accumulator (last stage)
+        ),
+        tuple(sorted(vma)),
+    )
+
+    def tick(carry, t):
+        fwd_in, cot_in, ring, grads, loss_acc = carry
+
+        # ---- forward lane: microbatch f = t − i ---------------------------
+        f = t - i
+        f_valid = (f >= 0) & (f < m)
+        f_idx = jnp.clip(f, 0, m - 1)
+        mb_in = jnp.take(inputs, f_idx, axis=0)
+        x_emb = embed_fn(embed_params, mb_in)
+        x_in = jnp.where(i == 0, x_emb, fwd_in)
+        y = stage_fn(stage_params, x_in)
+        # Stash this tick's stage input for the backward-tick recompute;
+        # on an invalid tick keep the slot's previous contents (a clamped
+        # f_idx may alias a still-live slot).
+        slot = f_idx % slots
+        old = jnp.take(ring, slot, axis=0)
+        ring = lax.dynamic_update_index_in_dim(
+            ring, jnp.where(f_valid, x_in, old), slot, 0
+        )
+
+        # ---- backward lane: microbatch b = t − (2P − 1 − i) ---------------
+        b = t - (2 * n - 1 - i)
+        b_valid = (b >= 0) & (b < m)
+        b_idx = jnp.clip(b, 0, m - 1)
+        x_b = jnp.take(ring, b_idx % slots, axis=0)
+        y_b, stage_vjp = jax.vjp(stage_fn, stage_params, x_b)
+
+        # Last stage: per-microbatch head + loss on the recomputed output
+        # (the 1/m seed makes the accumulated loss/grads the microbatch
+        # mean). Other stages: the cotangent that just arrived.
+        mb_tgt = jnp.take(targets, b_idx, axis=0)
+        loss_b, head_vjp = jax.vjp(
+            lambda hp, yy: head_loss_fn(hp, yy, mb_tgt), head_params, y_b
+        )
+        # The cotangent seed must carry the primal's device-varying type.
+        seed = C.vary(
+            jnp.float32(1.0 / m),
+            tuple(getattr(jax.typeof(loss_b), "vma", frozenset()) or ()),
+        )
+        d_head, dy_head = head_vjp(seed)
+        is_last = i == n - 1
+        dy = jnp.where(is_last, dy_head, cot_in)
+        d_stage, dx = stage_vjp(dy)
+
+        # Stage-0 ingestion backward: fold dx through the embedding.
+        mb_b_in = jnp.take(inputs, b_idx, axis=0)
+        _, embed_vjp = jax.vjp(embed_fn, embed_params, mb_b_in)
+        (d_embed,) = embed_vjp(dx)[:1]
+
+        def acc(g, d, valid):
+            return jax.tree.map(
+                lambda a, b_: a + jnp.where(valid, b_, jnp.zeros_like(b_)),
+                g,
+                d,
+            )
+
+        grads = {
+            "stages": acc(grads["stages"], d_stage, b_valid),
+            "embed": acc(grads["embed"], d_embed, b_valid & (i == 0)),
+            "head": acc(grads["head"], d_head, b_valid & is_last),
+        }
+        loss_acc = loss_acc + jnp.where(
+            b_valid & is_last, loss_b.astype(jnp.float32) / m, 0.0
+        )
+
+        # ---- ring hops: activations forward, cotangents backward ----------
+        fwd_in = C.shift(y, axis, offset=1)
+        cot_in = C.shift(dx, axis, offset=-1)
+        return (fwd_in, cot_in, ring, grads, loss_acc), None
+
+    (_, _, _, grads, loss_acc), _ = lax.scan(
+        tick, init, jnp.arange(m + 2 * n - 1)
+    )
+    # Loss lives on the last stage; replicate it.
+    loss = C.broadcast(loss_acc, axis, root=n - 1)
+    return loss, grads

@@ -16,10 +16,15 @@ Parameter/gradient geometry (the part worth reading):
 - **Embedding (wte/wpe)** is consumed by the pipeline's stage-0 ingestion,
   so its gradient lands only on pipe coordinate 0 → ``psum`` over pipe
   completes (and re-types) it.
-- **Head/final-LN** grads are computed identically on every pipe device
-  (the pipeline output is broadcast) → a ``pmean`` over pipe is a
-  numerical no-op that re-types them pipe-invariant (psum would multiply
-  by ``n_pipe``).
+- **Head/final-LN** run on the LAST stage only: the loss is computed on
+  the last stage's (non-broadcast) pipeline outputs and masked to that
+  coordinate, so head grads land there and the same ``psum`` over pipe
+  completes them. (Round 1 instead ran the head on the *broadcast*
+  outputs on every device and pmean'd — wrong: with the head params
+  pipe-varying, the broadcast's AD transpose psums the output cotangent
+  over pipe, scaling every stage grad by ``n_pipe``; adam's scale
+  invariance masked it until the round-2 per-leaf parity tests. See
+  ``spmd_pipeline(broadcast_outputs=...)``.)
 - Weight tying would put one parameter (wte) in two categories at once,
   which per-leaf combine cannot express — the pp tier requires
   ``GPT2Config.tie_head=False`` (enforced).
@@ -53,7 +58,11 @@ from mpit_tpu.comm import collectives as C
 from mpit_tpu.models.gpt2 import Block, GPT2Config
 from mpit_tpu.ops.lm_head import lm_head_xent
 from mpit_tpu.opt.sharded import state_partition_specs
-from mpit_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
+from mpit_tpu.parallel.pipeline import (
+    spmd_pipeline,
+    spmd_pipeline_1f1b,
+    stack_stage_params,
+)
 from mpit_tpu.train.step import TrainState
 
 
@@ -86,6 +95,7 @@ def make_gpt2_pp_train_step(
     pipe_axis: str = "pipe",
     num_microbatches: int = 4,
     zero1: bool = False,
+    schedule: str = "gpipe",
     donate: bool = True,
 ):
     """Build ``(init_fn, step_fn, state_specs)`` for pipeline-parallel GPT-2.
@@ -95,12 +105,23 @@ def make_gpt2_pp_train_step(
     Requires ``cfg.num_layers % n_pipe == 0``, ``cfg.tie_head == False``
     and per-device batch divisible by ``num_microbatches`` (see module
     docstring for why, and for the ``zero1`` restriction).
+
+    ``schedule``: ``"gpipe"`` (all-forward scan + AD reverse pipeline —
+    the oracle; M in-flight microbatch residuals) or ``"1f1b"``
+    (interleaved one-fwd-one-bwd via
+    :func:`~mpit_tpu.parallel.pipeline.spmd_pipeline_1f1b` — per-device
+    activation memory bounded at ``2·P`` stage inputs independent of M,
+    per-microbatch head/loss inside the schedule, stage recompute in the
+    backward tick). Same update semantics; trajectory-parity-tested
+    against each other and against single-device AD.
     """
     if cfg.tie_head:
         raise ValueError(
             "pipeline parallelism requires an untied LM head: "
             "GPT2Config(tie_head=False) — see parallel.pp docstring"
         )
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule must be 'gpipe' or '1f1b', got {schedule!r}")
     n_pipe = world.axis_size(pipe_axis)
     n_data = world.axis_size(data_axis)
     # One stateless ZeRO-1 wrapper serves both placement groups (module
@@ -230,7 +251,21 @@ def make_gpt2_pp_train_step(
                 cfg.dtype
             )
             xm = x.reshape(m, b // m, t, x.shape[-1])
-            ym = spmd_pipeline(stage_fn, local_stage, xm, axis=pipe_axis)
+            # No broadcast in the differentiated path: with the head
+            # params pipe-varying, differentiating through the broadcast
+            # would psum the output cotangent over pipe and scale every
+            # stage grad by P (see spmd_pipeline's broadcast_outputs
+            # docstring — the round-1 bug this replaced). The head/loss
+            # run on the last stage's real outputs only; grads for all
+            # rest leaves therefore land on one pipe coordinate and are
+            # completed by the psum combine below.
+            ym = spmd_pipeline(
+                stage_fn,
+                local_stage,
+                xm,
+                axis=pipe_axis,
+                broadcast_outputs=False,
+            )
             h = ym.reshape(b, t, x.shape[-1])
             # Fused streaming LM-head xent (ops/lm_head.py): the local
             # [b, t, vocab] f32 logits are never materialized.
@@ -240,22 +275,66 @@ def make_gpt2_pp_train_step(
                 targets,
                 compute_dtype=cfg.head_dtype,
             )
-            return jnp.mean(losses)
+            is_last = C.rank(pipe_axis) == n_pipe - 1
+            return jnp.where(is_last, jnp.mean(losses), 0.0)
 
         local = C.vary(state.params, axes)
-        loss, grads = jax.value_and_grad(loss_fn)(local)
+        if schedule == "1f1b":
+            # The 1F1B schedule owns its backward (per-microbatch head +
+            # vjp inside the ticks) and returns grads directly; embed and
+            # head grads land only on pipe coords 0 / P-1 → psum over
+            # pipe completes every rest leaf (no pmean cases here).
+            def embed_fn(ep, mb):
+                return ep["wte"][mb].astype(cfg.dtype) + ep["wpe"][:t].astype(
+                    cfg.dtype
+                )
 
-        # Per-subtree pipe combine (module docstring), then the data mean.
-        def pipe_combine(name, g):
-            if name in ("wte", "wpe"):
-                return jax.tree.map(lambda l: lax.psum(l, pipe_axis), g)
-            return jax.tree.map(lambda l: lax.pmean(l, pipe_axis), g)
+            def head_loss_fn(hp, y, tgt):
+                losses = lm_head_xent(
+                    _final_norm(hp, y),
+                    hp["head"],
+                    tgt,
+                    compute_dtype=cfg.head_dtype,
+                )
+                return jnp.mean(losses)
 
-        g_rest = {k: pipe_combine(k, v) for k, v in grads["rest"].items()}
-        local_grads = {
-            "stages": jax.tree.map(lambda l: l[0], grads["stages"]),
-            "rest": g_rest,
-        }
+            rest = local["rest"]
+            p1 = {
+                "stages": local["stages"],
+                "embed": {"wte": rest["wte"], "wpe": rest["wpe"]},
+                "head": {"ln_f": rest["ln_f"], "head": rest["head"]},
+            }
+            loss, g = spmd_pipeline_1f1b(
+                stage_fn,
+                embed_fn,
+                head_loss_fn,
+                p1,
+                inp.reshape(m, b // m, t),
+                targets.reshape(m, b // m, t),
+                axis=pipe_axis,
+            )
+            g_rest = jax.tree.map(
+                lambda l: lax.psum(l, pipe_axis),
+                {**g["embed"], **g["head"]},
+            )
+            local_grads = {"stages": g["stages"], "rest": g_rest}
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(local)
+            # The loss lives on the last pipe coordinate (masked above);
+            # recover the global value for metrics.
+            loss = lax.psum(loss, pipe_axis)
+
+            # Pipe combine: wte/wpe grads land on pipe coord 0 (stage-0
+            # ingestion), head/ln_f on coord P-1 (the masked loss) —
+            # psum over pipe completes every rest leaf. Stage grads are
+            # complete per device.
+            g_rest = jax.tree.map(
+                lambda l: lax.psum(l, pipe_axis), grads["rest"]
+            )
+            local_grads = {
+                "stages": jax.tree.map(lambda l: l[0], grads["stages"]),
+                "rest": g_rest,
+            }
 
         local_params = _local_view(state.params)
         if zero1:
@@ -285,7 +364,10 @@ def make_gpt2_pp_train_step(
             "stages": jax.tree.map(lambda l: l[None], new_local["stages"]),
             "rest": new_local["rest"],
         }
-        metrics = {"loss": lax.pmean(lax.pmean(loss, pipe_axis), data_axis)}
+        # Both schedules deliver a pipe-invariant loss by here (gpipe:
+        # psum of the last-stage-masked loss; 1f1b: broadcast from the
+        # last stage); only the data mean remains.
+        metrics = {"loss": lax.pmean(loss, data_axis)}
         return (
             TrainState(
                 step=state.step + 1,

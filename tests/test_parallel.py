@@ -713,3 +713,253 @@ class TestPipelineZero1:
             assert factor >= world.axis_size("data"), l.sharding.spec
             shard = next(iter(l.addressable_shards))
             assert shard.data.size * factor == l.size
+
+
+class Test1F1BSchedule:
+    """spmd_pipeline_1f1b (round 2): interleaved fwd/bwd with O(P) memory."""
+
+    def _build(self, schedule, zero1=False):
+        import mpit_tpu
+        from mpit_tpu.models import GPT2
+        from mpit_tpu.opt import goo_adam
+        from mpit_tpu.parallel import make_gpt2_pp_train_step, split_gpt2_params
+
+        # f32 activations: the 1f1b backward RECOMPUTES the stage forward
+        # while GPipe-AD reuses saved residuals — in bf16 the two round
+        # differently on near-zero grads, which adam's sign-normalizing
+        # update then amplifies; f32 makes the parity sharp.
+        cfg = GPT2Config.tiny(
+            num_heads=2, max_seq_len=64, num_layers=4, tie_head=False,
+            dtype=jnp.float32,
+        )
+        # goo SGD+momentum, not adam: adam's sign-normalizing update turns
+        # ~1e-7 summation-order noise (1f1b reduces the loss per
+        # microbatch, gpipe over the full batch) into ~lr-sized param
+        # deltas on near-zero-grad elements; SGD keeps the comparison a
+        # direct test of the hand-rolled backward.
+        from mpit_tpu.opt import goo
+
+        tx = goo(0.05, 0.9)
+        world = mpit_tpu.init({"data": 2, "pipe": 4}, set_default=False)
+        model = GPT2(cfg)
+        full = jax.jit(model.init)(
+            jax.random.key(0), jnp.zeros((1, 64), jnp.int32)
+        )["params"]
+        split = split_gpt2_params(full, cfg.num_layers, 4)
+        init_fn, step_fn, _ = make_gpt2_pp_train_step(
+            cfg, tx, world, num_microbatches=4, zero1=zero1,
+            schedule=schedule,
+        )
+        return world, split, init_fn, step_fn
+
+    @pytest.mark.parametrize("zero1", [False, True])
+    def test_matches_gpipe_trajectory(self, zero1):
+        """1F1B's hand-rolled backward must track the AD oracle exactly:
+        per-leaf params after 3 steps, not just losses."""
+        from mpit_tpu.data import SyntheticLM, shard_batch
+
+        stream = SyntheticLM(vocab_size=512, seed=0).batches(8, 64)
+        world, split, init_a, step_a = self._build("1f1b", zero1=zero1)
+        _, _, init_b, step_b = self._build("gpipe", zero1=zero1)
+        sa, sb = init_a(split), init_b(split)
+        for _ in range(3):
+            batch = shard_batch(world, {"tokens": next(stream)["tokens"]})
+            sa, ma = step_a(sa, batch)
+            sb, mb = step_b(sb, batch)
+            np.testing.assert_allclose(
+                float(ma["loss"]), float(mb["loss"]), rtol=2e-5
+            )
+        # Writing this test found a real round-1 bug: the gpipe head ran
+        # on broadcast outputs with pipe-varying head params, so the
+        # broadcast's AD transpose psum'ed the cotangent — every stage
+        # grad scaled by n_pipe (masked by adam's scale invariance; see
+        # parallel/pp.py module docstring). With the fix both schedules
+        # track single-device AD, so the tolerance here is tight.
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            ),
+            sa.params,
+            sb.params,
+        )
+
+    def test_memory_flat_in_microbatch_count(self):
+        """The 1F1B memory bound (VERDICT round-1 item 7 done-criterion):
+        compiled temp memory of the 1f1b step is constant in M (the
+        stage-input ring is ``live_microbatch_slots(P) = 2P`` slots),
+        while GPipe-through-AD's grows linearly with M."""
+        import mpit_tpu
+        from mpit_tpu.comm import collectives as C
+        from mpit_tpu.parallel import (
+            live_microbatch_slots,
+            spmd_pipeline,
+            spmd_pipeline_1f1b,
+        )
+
+        assert live_microbatch_slots(4) == 8
+        world = mpit_tpu.init(
+            {"pipe": 4}, set_default=False, devices=jax.devices()[:4]
+        )
+        d = 32
+
+        def temp_bytes(m, use_1f1b):
+            stage_p = jnp.zeros((4, 1, d, d))
+            emb = {"w": jnp.zeros((d, d))}
+            head = {"w": jnp.zeros((d, d))}
+            xs = jnp.zeros((m, 2, d))
+            tg = jnp.zeros((m, 2, d))
+
+            def stage_fn(p, x):
+                return jnp.tanh(x @ p[0])
+
+            if use_1f1b:
+                def f(stage_p, emb, head, xs, tg):
+                    params = {"stages": stage_p, "embed": emb, "head": head}
+                    return spmd_pipeline_1f1b(
+                        stage_fn,
+                        lambda ep, mb: mb @ ep["w"],
+                        lambda hp, y, t: jnp.mean((y @ hp["w"] - t) ** 2),
+                        params, xs, tg, axis="pipe",
+                    )
+
+                out_g = {
+                    "stages": jax.tree.map(lambda _: P("pipe"), stage_p),
+                    "embed": {"w": P("pipe")},
+                    "head": {"w": P("pipe")},
+                }
+            else:
+                def f(stage_p, emb, head, xs, tg):
+                    def loss_fn(sp, e, h):
+                        xe = xs @ e["w"]
+                        y = spmd_pipeline(stage_fn, sp, xe, axis="pipe")
+                        return jnp.mean((y @ h["w"] - tg) ** 2)
+
+                    return jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+                        C.vary(stage_p, "pipe"), emb, head
+                    )
+
+                out_g = (
+                    jax.tree.map(lambda _: P("pipe"), stage_p),
+                    {"w": P("pipe")},
+                    {"w": P("pipe")},
+                )
+            g = world.shard_map(
+                f,
+                in_specs=(P("pipe"), P(), P(), P(), P()),
+                out_specs=(P(), out_g),
+            )
+            comp = jax.jit(g).lower(stage_p, emb, head, xs, tg).compile()
+            ma = comp.memory_analysis()
+            return getattr(ma, "temp_size_in_bytes", None)
+
+        t1 = [temp_bytes(m, True) for m in (4, 32)]
+        tg_ = [temp_bytes(m, False) for m in (4, 32)]
+        if t1[0] is None or tg_[0] is None:
+            pytest.skip("backend exposes no memory_analysis")
+        # 1f1b: flat in M (allow a tiny slack for the index arrays);
+        # gpipe: grows by at least the 28 extra microbatch residual sets.
+        assert t1[1] <= t1[0] * 1.1 + 4096, (t1, tg_)
+        assert tg_[1] > tg_[0] * 3, (t1, tg_)
+
+
+class TestPerLeafGradientParity:
+    """VERDICT round-1 item 8: the tiers' effective gradients checked
+    leaf-by-leaf against single-device autodiff (one optimizer step with
+    plain goo SGD, so grads map linearly to param deltas — writing the
+    PP variant of this test exposed the round-1 broadcast-cotangent bug)."""
+
+    def _ref_step(self, model, full, toks, tx):
+        import optax
+
+        def ref_loss(p):
+            return jnp.mean(
+                model.apply({"params": p}, toks[:, :-1], targets=toks[:, 1:])
+            )
+
+        _, g = jax.value_and_grad(ref_loss)(full)
+        up, _ = tx.update(g, tx.init(full), full)
+        return optax.apply_updates(full, up)
+
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_pp_step_matches_single_device(self, schedule):
+        import mpit_tpu
+        from mpit_tpu.data import shard_batch
+        from mpit_tpu.opt import goo
+        from mpit_tpu.parallel import make_gpt2_pp_train_step, split_gpt2_params
+
+        cfg = GPT2Config.tiny(
+            num_heads=2, max_seq_len=64, num_layers=4, tie_head=False,
+            dtype=jnp.float32,
+        )
+        world = mpit_tpu.init({"data": 2, "pipe": 4}, set_default=False)
+        model = GPT2(cfg)
+        full = jax.jit(model.init)(
+            jax.random.key(0), jnp.zeros((1, 64), jnp.int32)
+        )["params"]
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 512, size=(8, 65)).astype(
+                np.int32
+            )
+        )
+        ref = split_gpt2_params(
+            self._ref_step(model, full, toks, goo(0.05, 0.9)), cfg.num_layers, 4
+        )
+        split = split_gpt2_params(full, cfg.num_layers, 4)
+        init_fn, step_fn, _ = make_gpt2_pp_train_step(
+            cfg, goo(0.05, 0.9), world, num_microbatches=4, schedule=schedule
+        )
+        state, _ = step_fn(init_fn(split), shard_batch(world, {"tokens": toks}))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+            ),
+            state.params,
+            ref,
+        )
+
+    def test_cp_step_matches_single_device(self):
+        import mpit_tpu
+        from mpit_tpu.data import shard_batch
+        from mpit_tpu.opt import goo
+        from mpit_tpu.parallel import make_gpt2_cp_train_step
+
+        cfg = GPT2Config.tiny(num_heads=2, max_seq_len=64, dtype=jnp.float32)
+        world = mpit_tpu.init({"data": 2, "seq": 4}, set_default=False)
+        model = GPT2(cfg)
+        full = jax.jit(model.init)(
+            jax.random.key(0), jnp.zeros((1, 64), jnp.int32)
+        )["params"]
+        # The cp step trains on [B, T]: T tokens, T-1 supervised positions
+        # (the global last has no target). Mirror that exactly in the ref:
+        toks = jnp.asarray(
+            np.random.RandomState(1).randint(0, 512, size=(8, 64)).astype(
+                np.int32
+            )
+        )
+        import optax
+
+        def ref_loss(p):
+            losses = model.apply(
+                {"params": p}, toks, targets=jnp.pad(toks[:, 1:], ((0, 0), (0, 1)))
+            )
+            return jnp.sum(losses[:, :-1]) / (toks.shape[0] * (toks.shape[1] - 1))
+
+        _, g = jax.value_and_grad(ref_loss)(full)
+        tx = goo(0.05, 0.9)
+        up, _ = tx.update(g, tx.init(full), full)
+        ref = optax.apply_updates(full, up)
+
+        from jax.sharding import PartitionSpec as P
+
+        init_fn, step_fn, _ = make_gpt2_cp_train_step(
+            cfg, goo(0.05, 0.9), world, zero1=False
+        )
+        batch = shard_batch(world, {"tokens": toks}, spec=P("data", "seq"))
+        state, _ = step_fn(init_fn(full), batch)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+            ),
+            state.params,
+            ref,
+        )
