@@ -53,6 +53,7 @@ class TrainConfig:
     nranks: int = 2  # parity mode: 1 pserver + (nranks-1) pclients
     mesh: str = ""  # SPMD mesh, e.g. "data=4,model=2"; "" = all-data
     native: bool = False  # C++ data-pipeline core (falls back if unbuilt)
+    data_dir: str = ""  # on-disk dataset (data/filedata.py); "" = synthetic
     log_every: int = 50
     profile_dir: str = ""  # capture a jax.profiler trace of steps 2..5
     ckpt_dir: str = ""  # orbax checkpoint directory ("" = no checkpoints)

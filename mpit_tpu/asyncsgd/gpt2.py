@@ -44,6 +44,12 @@ class GPT2TrainConfig(TrainConfig):
     ulysses: bool = False  # cp tier: all-to-all Ulysses instead of the ring
     microbatches: int = 4  # pp tier: microbatch count
     pp_schedule: str = "gpipe"  # pp tier: "gpipe" (AD oracle) | "1f1b"
+    # ep tier (--mesh data=..,expert=..): routed-MoE MLPs (parallel.ep)
+    moe_experts: int = 8
+    moe_k: int = 2
+    moe_capacity: float = 1.25
+    moe_every: int = 2  # every Nth block is MoE
+    aux_weight: float = 0.01  # load-balance aux loss weight
     lr: float = 3e-4
     batch_size: int = 8
     fsdp_axis: str = ""  # e.g. "data" to compose ZeRO-3 with TP
@@ -77,9 +83,16 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             "parallel tiers, not the legacy async protocol"
         )
     print(runner.describe(cfg, "gpt2"))
+    if cfg.data_dir:
+        from mpit_tpu.data import FileLM
+
+        dataset = FileLM(cfg.data_dir, seed=cfg.seed)
+        # Vocab comes from the on-disk dataset, not the flag.
+        cfg = dataclasses.replace(cfg, vocab_size=dataset.vocab_size)
+    else:
+        dataset = SyntheticLM(vocab_size=cfg.vocab_size, seed=cfg.seed)
     mcfg = cfg.model_config()
     model = GPT2(mcfg)
-    dataset = SyntheticLM(vocab_size=cfg.vocab_size, seed=cfg.seed)
 
     def init_params():
         tokens = jnp.zeros((1, cfg.seq_len), jnp.int32)
@@ -120,12 +133,110 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             "gpt2: --ulysses true requires the cp tier (a mesh with a seq "
             "axis, e.g. --mesh data=4,seq=2)"
         )
-    if mesh_shape and "pipe" in mesh_shape:
+    if mesh_shape and "expert" in mesh_shape:
+        # Expert-parallel tier (parallel.ep): routed-MoE MLPs, experts
+        # sharded over the expert axis, tokens over data x expert.
+        if cfg.ckpt_dir:
+            raise SystemExit("gpt2: --ckpt-dir is not yet supported on the ep tier")
+        if set(mesh_shape) - {"data", "expert"}:
+            raise SystemExit(
+                "gpt2: the ep tier composes with a data axis only "
+                "(--mesh data=..,expert=..)"
+            )
+        if "data" not in mesh_shape:
+            mesh_shape = {"data": 1, **mesh_shape}
+        from jax.sharding import PartitionSpec as P_
+        from mpit_tpu.data import shard_batch
+        from mpit_tpu.models.gpt2_moe import GPT2MoE, MoESettings
+        from mpit_tpu.parallel import make_gpt2_moe_train_step
+
+        world = mpit_tpu.init(mesh_shape)
+        moe = MoESettings(
+            num_experts=cfg.moe_experts,
+            k=cfg.moe_k,
+            capacity_factor=cfg.moe_capacity,
+            every=cfg.moe_every,
+        )
+        moe_model = GPT2MoE(mcfg, moe)
+        init_fn, step_fn, _ = make_gpt2_moe_train_step(
+            mcfg, moe, tx, world, aux_weight=cfg.aux_weight, zero1=cfg.zero1
+        )
+
+        def moe_init():
+            tokens = jnp.zeros((1, cfg.seq_len), jnp.int32)
+            return (
+                jax.jit(moe_model.init)(jax.random.key(cfg.seed), tokens)[
+                    "params"
+                ],
+                (),
+            )
+
+        init_params = moe_init  # noqa: F811 — ep uses the MoE param tree
+        state, losses = drive(
+            init_fn, step_fn,
+            lambda b: shard_batch(
+                world,
+                {"tokens": np.asarray(b["tokens"])[:, : cfg.seq_len + 1]},
+                spec=P_(("data", "expert")),
+            ),
+        )
+        tier = f"ep-top{cfg.moe_k}-e{cfg.moe_experts}"
+    elif mesh_shape and "pipe" in mesh_shape and "model" in mesh_shape:
+        # 3-D tier (parallel.threed): data x model x pipe.
+        if cfg.ckpt_dir:
+            raise SystemExit("gpt2: --ckpt-dir is not yet supported on the 3-D tier")
+        if set(mesh_shape) - {"data", "model", "pipe"}:
+            raise SystemExit(
+                "gpt2: the dp-tp-pp tier composes exactly data, model and "
+                "pipe axes (--mesh data=..,model=..,pipe=..)"
+            )
+        if cfg.flash or cfg.ulysses:
+            raise SystemExit(
+                "gpt2: --flash/--ulysses are not supported on the 3-D "
+                "tiers (the Megatron block uses XLA attention; ring "
+                "attention only on the seq-axis tier)"
+            )
+        if "data" not in mesh_shape:
+            mesh_shape = {"data": 1, **mesh_shape}
+        from mpit_tpu.data import shard_batch
+        from mpit_tpu.parallel import (
+            make_gpt2_dp_tp_pp_train_step,
+            split_gpt2_params_3d,
+        )
+
+        world = mpit_tpu.init(mesh_shape)
+        mcfg_3d = dataclasses.replace(mcfg, tie_head=False)
+        m3 = GPT2(mcfg_3d)
+        init_fn, step_fn, _ = make_gpt2_dp_tp_pp_train_step(
+            mcfg_3d, tx, world, num_microbatches=cfg.microbatches,
+            zero1=cfg.zero1,
+        )
+
+        def d3_init():
+            tokens = jnp.zeros((1, cfg.seq_len), jnp.int32)
+            full = jax.jit(m3.init)(jax.random.key(cfg.seed), tokens)["params"]
+            return (
+                split_gpt2_params_3d(
+                    full, mcfg_3d.num_layers,
+                    world.axis_size("pipe"), world.axis_size("model"),
+                ),
+                (),
+            )
+
+        init_params = d3_init  # noqa: F811
+        state, losses = drive(
+            init_fn, step_fn,
+            lambda b: shard_batch(
+                world, {"tokens": np.asarray(b["tokens"])[:, : cfg.seq_len + 1]}
+            ),
+        )
+        tier = "3d-dp-tp-pp"
+    elif mesh_shape and "pipe" in mesh_shape:
         # Pipeline-parallel tier (parallel.pp): blocks split into stages
         # over the pipe axis, GPipe microbatch ring, untied LM head.
         if cfg.ckpt_dir:
             raise SystemExit("gpt2: --ckpt-dir is not yet supported on the pp tier")
-        if "seq" in mesh_shape or "model" in mesh_shape:
+        if "seq" in mesh_shape:
             raise SystemExit(
                 "gpt2: the pp tier composes only with a data axis "
                 "(--mesh data=..,pipe=..)"
@@ -159,19 +270,63 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             ),
         )
         tier = f"pp-{cfg.pp_schedule}-m{cfg.microbatches}"
+    elif mesh_shape and "seq" in mesh_shape and "model" in mesh_shape:
+        # 3-D tier (parallel.threed): ring attention INSIDE the Megatron
+        # block — data x seq x model (TP inside CP).
+        if cfg.ckpt_dir:
+            raise SystemExit("gpt2: --ckpt-dir is not yet supported on the 3-D tier")
+        if set(mesh_shape) - {"data", "seq", "model"}:
+            raise SystemExit(
+                "gpt2: the dp-cp-tp tier composes exactly data, seq and "
+                "model axes (--mesh data=..,seq=..,model=..)"
+            )
+        if cfg.flash or cfg.ulysses:
+            raise SystemExit(
+                "gpt2: --flash/--ulysses are not supported on the 3-D "
+                "tiers (the dp-cp-tp tier hardcodes the XLA K/V ring; "
+                "use --mesh data=..,seq=.. for the flash/ulysses options)"
+            )
+        if "data" not in mesh_shape:
+            mesh_shape = {"data": 1, **mesh_shape}
+        from jax.sharding import PartitionSpec as P_
+        from mpit_tpu.data import shard_batch
+        from mpit_tpu.parallel import (
+            make_gpt2_dp_cp_tp_train_step,
+            stack_gpt2_blocks,
+        )
+
+        world = mpit_tpu.init(mesh_shape)
+        m7 = GPT2(mcfg)
+        init_fn, step_fn, _ = make_gpt2_dp_cp_tp_train_step(
+            mcfg, tx, world, zero1=cfg.zero1
+        )
+
+        def cptp_init():
+            tokens = jnp.zeros((1, cfg.seq_len), jnp.int32)
+            full = jax.jit(m7.init)(jax.random.key(cfg.seed), tokens)["params"]
+            return (
+                stack_gpt2_blocks(
+                    full, mcfg.num_layers, world.axis_size("model")
+                ),
+                (),
+            )
+
+        init_params = cptp_init  # noqa: F811
+        state, losses = drive(
+            init_fn, step_fn,
+            lambda b: shard_batch(
+                world,
+                {"tokens": np.asarray(b["tokens"])[:, : cfg.seq_len]},
+                spec=P_("data", "seq"),
+            ),
+        )
+        tier = "3d-dp-cp-tp"
     elif mesh_shape and "seq" in mesh_shape:
         # Context-parallel tier: sequence sharded over the seq axis, ring
         # attention inside, cross-shard next-token targets (parallel.cp).
         if cfg.ckpt_dir:
             raise SystemExit(
                 "gpt2: --ckpt-dir is not yet supported on the cp tier"
-            )
-        if "model" in mesh_shape:
-            raise SystemExit(
-                "gpt2: a mesh with both 'seq' and 'model' axes is not "
-                "supported — the cp tier would leave the model axis doing "
-                "replicated work; pick one of --mesh data=..,seq=.. or "
-                "--mesh data=..,model=.."
             )
         if "data" not in mesh_shape:
             # Pure CP: a trivial 1-wide data axis keeps the step's specs.

@@ -35,9 +35,19 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         ImagenetConfig, argv, prog="asyncsgd.imagenet", overrides=overrides
     )
     print(runner.describe(cfg, "imagenet-alexnet"))
-    dataset = synthetic_imagenet(
-        image_size=cfg.image_size, num_classes=cfg.num_classes, seed=cfg.seed
+    dataset = runner.classification_dataset(
+        cfg,
+        lambda: synthetic_imagenet(
+            image_size=cfg.image_size, num_classes=cfg.num_classes, seed=cfg.seed
+        ),
     )
+    if cfg.data_dir:
+        # Geometry comes from the on-disk dataset, not the flags.
+        cfg = dataclasses.replace(
+            cfg,
+            num_classes=dataset.num_classes,
+            image_size=dataset.image_shape[0],
+        )
     model = AlexNet(num_classes=cfg.num_classes)
 
     if cfg.mode == "parity":

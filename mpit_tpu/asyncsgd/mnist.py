@@ -32,8 +32,16 @@ from mpit_tpu.models import LeNet
 def main(argv: list[str] | None = None, **overrides) -> dict:
     cfg = from_argv(TrainConfig, argv, prog="asyncsgd.mnist", overrides=overrides)
     print(runner.describe(cfg, "mnist-lenet"))
-    dataset = synthetic_mnist(seed=cfg.seed)
-    model = LeNet()
+    dataset = runner.classification_dataset(
+        cfg, lambda: synthetic_mnist(seed=cfg.seed)
+    )
+    num_classes = getattr(dataset, "num_classes", 10)
+    if cfg.data_dir and dataset.image_shape != (28, 28, 1):
+        raise SystemExit(
+            f"mnist: --data-dir images are {dataset.image_shape}, LeNet "
+            "expects (28, 28, 1)"
+        )
+    model = LeNet(num_classes=num_classes)
 
     if cfg.mode == "parity":
         return runner.run_parity_classifier(cfg, model, dataset)

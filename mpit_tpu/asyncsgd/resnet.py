@@ -40,9 +40,18 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             "BatchNorm and has no defined semantics for its running stats"
         )
     print(runner.describe(cfg, "imagenet-resnet50"))
-    dataset = synthetic_imagenet(
-        image_size=cfg.image_size, num_classes=cfg.num_classes, seed=cfg.seed
+    dataset = runner.classification_dataset(
+        cfg,
+        lambda: synthetic_imagenet(
+            image_size=cfg.image_size, num_classes=cfg.num_classes, seed=cfg.seed
+        ),
     )
+    if cfg.data_dir:
+        cfg = dataclasses.replace(
+            cfg,
+            num_classes=dataset.num_classes,
+            image_size=dataset.image_shape[0],
+        )
     model = ResNet50(num_classes=cfg.num_classes)
 
     def init_params():

@@ -51,6 +51,17 @@ def accuracy(logits, labels):
     return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
 
 
+def classification_dataset(cfg: TrainConfig, synthetic_factory):
+    """``--data-dir`` selects the on-disk dataset (``data/filedata.py``,
+    the reference's real-MNIST/ImageNet role); else the synthetic
+    stand-in from ``synthetic_factory()``."""
+    if cfg.data_dir:
+        from mpit_tpu.data import FileClassification
+
+        return FileClassification(cfg.data_dir, seed=cfg.seed)
+    return synthetic_factory()
+
+
 def make_stream(cfg: TrainConfig, dataset, *args):
     """The workload scripts' input stream: native C++ core when
     ``cfg.native`` (with internal fallback), else the Python generator.

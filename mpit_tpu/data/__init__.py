@@ -10,24 +10,40 @@ so the pipeline design is:
   224×224×3, LM token streams). Learnable means labels are a function of
   the inputs (class prototypes + noise; induced token grammar), so
   loss-decrease and accuracy tests are meaningful.
+- :mod:`mpit_tpu.data.filedata` — the real-data path (round 2): a
+  directory-of-npy on-disk format, memory-mapped, behind the same
+  ``batches()/eval_batch()`` interface — ``--data-dir`` on the workload
+  scripts (BASELINE.json configs #1–#4 train from disk in the reference).
 - :mod:`mpit_tpu.data.loader` — batching, host→device prefetch (double
   buffered), and global-batch sharding over the mesh's data axis. Real
   dataset loaders plug in behind the same iterator interface.
 """
 
+from mpit_tpu.data.filedata import (
+    FileClassification,
+    FileLM,
+    load_dataset,
+    write_classification,
+    write_lm,
+)
+from mpit_tpu.data.loader import Prefetcher, shard_batch
 from mpit_tpu.data.synthetic import (
     SyntheticClassification,
     SyntheticLM,
     synthetic_imagenet,
     synthetic_mnist,
 )
-from mpit_tpu.data.loader import Prefetcher, shard_batch
 
 __all__ = [
     "SyntheticClassification",
     "SyntheticLM",
     "synthetic_mnist",
     "synthetic_imagenet",
+    "FileClassification",
+    "FileLM",
+    "load_dataset",
+    "write_classification",
+    "write_lm",
     "Prefetcher",
     "shard_batch",
 ]

@@ -1,0 +1,180 @@
+"""GPT-2 with routed Mixture-of-Experts MLPs — the EP workload model.
+
+Not in the reference (SURVEY.md §3.3 lists EP as new-framework-only);
+round 2 turns the round-1 MoE dispatch library (``parallel/moe.py``) into
+a trainable model family + tier (verdict item 6). Architecture: the
+standard sparse-transformer pattern (Switch/GShard, arXiv:2101.03961) —
+every ``moe.every``-th block's dense MLP is replaced by a top-k routed
+expert MLP; attention/LN/embedding are exactly ``models.gpt2``.
+
+``moe.axis_name`` makes the same module expert-parallel: inside a
+``shard_map`` whose in_specs shard the expert-indexed leaves over that
+axis, the dispatch's all-to-alls route tokens to expert owners
+(``parallel.ep`` builds the full training step). ``axis_name=None`` is
+the dense single-device path — the parity oracle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mpit_tpu.models.gpt2 import GPT2Config
+from mpit_tpu.parallel.moe import expert_parallel_moe
+
+
+@dataclasses.dataclass(frozen=True)
+class MoESettings:
+    num_experts: int = 8
+    d_ff: int | None = None  # default: the block's ff_dim
+    k: int = 2
+    capacity_factor: float = 1.25
+    every: int = 2  # every Nth block is MoE (1 = all blocks)
+    axis_name: str | None = None  # mesh axis for EP; None = dense
+    reduce_aux: bool = True
+    # Expert-axis size the module will be APPLIED under: expert-indexed
+    # params are declared with their per-device shape [E/shards, ...]
+    # (flax validates declared shapes, and inside shard_map the leaves
+    # arrive as local shards). 1 = dense layout (init + single device).
+    shards: int = 1
+
+
+class MoEBlock(nn.Module):
+    """Pre-LN transformer block with a routed-MoE MLP half."""
+
+    cfg: GPT2Config
+    moe: MoESettings
+
+    @nn.compact
+    def __call__(self, x):
+        cfg, moe = self.cfg, self.moe
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(*t.shape[:-1], cfg.num_heads, cfg.head_dim)
+        attn = cfg.attention_fn(split(q), split(k), split(v), causal=True)
+        attn = attn.reshape(*attn.shape[:-2], cfg.d_model)
+        x = x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="proj")(attn)
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        d, e = cfg.d_model, moe.num_experts
+        f = moe.d_ff or cfg.ff_dim
+        if e % moe.shards:
+            raise ValueError(
+                f"num_experts ({e}) must divide by shards ({moe.shards})"
+            )
+        el = e // moe.shards  # per-device expert count (see MoESettings)
+        params = {
+            "router": self.param(
+                "router", nn.initializers.normal(0.02), (d, e), jnp.float32
+            ),
+            "w_in": self.param(
+                "w_in", nn.initializers.normal(0.02), (el, d, f), jnp.float32
+            ),
+            "b_in": self.param("b_in", nn.initializers.zeros, (el, f)),
+            "w_out": self.param(
+                "w_out", nn.initializers.normal(0.02), (el, f, d), jnp.float32
+            ),
+            "b_out": self.param("b_out", nn.initializers.zeros, (el, d)),
+        }
+        y, aux = expert_parallel_moe(
+            h.astype(cfg.dtype),
+            params,
+            k=moe.k,
+            capacity_factor=moe.capacity_factor,
+            axis=moe.axis_name,
+            reduce_aux=moe.reduce_aux,
+        )
+        return x + y, aux
+
+
+class GPT2MoE(nn.Module):
+    """GPT-2 with MoE MLPs every ``moe.every`` blocks.
+
+    ``__call__(tokens, positions=None, targets=None)`` returns
+    ``(logits_or_per_token_losses, aux)`` — the same contract as
+    :class:`~mpit_tpu.models.gpt2.GPT2` plus the summed load-balance aux
+    loss (add ``aux_weight * aux`` to the objective; Switch §2.2).
+    """
+
+    cfg: GPT2Config = GPT2Config()
+    moe: MoESettings = MoESettings()
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, targets=None):
+        from mpit_tpu.models.gpt2 import Block
+
+        cfg, moe = self.cfg, self.moe
+        wte = self.param(
+            "wte",
+            nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.d_model),
+            jnp.float32,
+        )
+        wpe = self.param(
+            "wpe",
+            nn.initializers.normal(0.01),
+            (cfg.max_seq_len, cfg.d_model),
+            jnp.float32,
+        )
+        t = tokens.shape[-1]
+        pe = wpe[:t] if positions is None else wpe[positions]
+        x = wte[tokens].astype(cfg.dtype) + pe.astype(cfg.dtype)
+        aux = jnp.zeros((), jnp.float32)
+        for i in range(cfg.num_layers):
+            if (i + 1) % moe.every == 0:
+                x, a = MoEBlock(cfg, moe, name=f"block_{i}")(x)
+                aux = aux + a
+            else:
+                x = Block(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        head = (
+            wte
+            if cfg.tie_head
+            else self.param(
+                "head",
+                nn.initializers.normal(0.02),
+                (cfg.vocab_size, cfg.d_model),
+                jnp.float32,
+            )
+        )
+        if targets is not None:
+            from mpit_tpu.ops.lm_head import lm_head_xent
+
+            return (
+                lm_head_xent(x, head, targets, compute_dtype=cfg.head_dtype),
+                aux,
+            )
+        logits = jnp.einsum(
+            "btd,vd->btv",
+            x.astype(cfg.head_dtype),
+            head.astype(cfg.head_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return logits, aux
+
+
+_EXPERT_LEAVES = ("w_in", "b_in", "w_out", "b_out")
+
+
+def expert_param_specs(params, expert_axis: str):
+    """PartitionSpecs for a GPT2MoE param tree under EP: expert-indexed
+    leaves sharded on their leading E dim; everything else (router,
+    attention, embeddings, head) replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec_for(path, leaf):
+        del leaf
+        name = str(getattr(path[-1], "key", getattr(path[-1], "name", "")))
+        return P(expert_axis) if name in _EXPERT_LEAVES else P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def is_expert_leaf(path) -> bool:
+    name = str(getattr(path[-1], "key", getattr(path[-1], "name", "")))
+    return name in _EXPERT_LEAVES

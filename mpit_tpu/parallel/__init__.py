@@ -43,12 +43,34 @@ from mpit_tpu.parallel.pipeline import (
 from mpit_tpu.parallel.pp import make_gpt2_pp_train_step, split_gpt2_params
 from mpit_tpu.parallel.megatron import (
     column_parallel_dense,
+    repack_qkv,
     row_parallel_dense,
+    tp_attention,
+    tp_block_specs,
     tp_mlp,
+    tp_transformer_block,
+    unpack_qkv,
 )
+from mpit_tpu.parallel.ep import make_gpt2_moe_train_step
 from mpit_tpu.parallel.moe import MoEMLP, expert_parallel_moe
+from mpit_tpu.parallel.threed import (
+    make_gpt2_dp_cp_tp_train_step,
+    make_gpt2_dp_tp_pp_train_step,
+    split_gpt2_params_3d,
+    stack_gpt2_blocks,
+)
 
 __all__ = [
+    "make_gpt2_moe_train_step",
+    "tp_attention",
+    "tp_transformer_block",
+    "tp_block_specs",
+    "repack_qkv",
+    "unpack_qkv",
+    "make_gpt2_dp_tp_pp_train_step",
+    "make_gpt2_dp_cp_tp_train_step",
+    "split_gpt2_params_3d",
+    "stack_gpt2_blocks",
     "make_gpt2_cp_train_step",
     "make_gpt2_pp_train_step",
     "split_gpt2_params",

@@ -78,6 +78,7 @@ def expert_parallel_moe(
     k: int = 2,
     capacity_factor: float = 1.25,
     axis: str | None = None,
+    reduce_aux: bool = True,
 ):
     """Routed MoE MLP; with ``axis`` set, experts are sharded over that mesh
     axis (call inside ``shard_map``; ``w_in``/``b_in``/``w_out``/``b_out``
@@ -86,7 +87,10 @@ def expert_parallel_moe(
     params: ``router`` [D, E_global], ``w_in`` [E(,local), D, F], ``b_in``
     [E, F], ``w_out`` [E, F, D], ``b_out`` [E, D].
 
-    Returns ``(out, aux_loss)`` with out shaped like x.
+    Returns ``(out, aux_loss)`` with out shaped like x. ``reduce_aux=False``
+    returns the LOCAL (this device's tokens) aux value instead of the
+    axis-pmean — the EP training tier sums it into its globally-normalized
+    objective itself (``parallel.ep``).
     """
     orig_shape = x.shape
     d = x.shape[-1]
@@ -126,7 +130,7 @@ def expert_parallel_moe(
     f_e = jnp.mean(top1, axis=0)
     p_e = jnp.mean(probs, axis=0)
     aux = e_global * jnp.sum(f_e * p_e)
-    if axis is not None:
+    if axis is not None and reduce_aux:
         aux = lax.pmean(aux, axis)
 
     return out.reshape(orig_shape).astype(x.dtype), aux

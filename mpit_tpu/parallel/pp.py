@@ -222,13 +222,11 @@ def make_gpt2_pp_train_step(
         return jax.jit(f)(split_params)
 
     def _final_norm(rest, h):
-        # flax nn.LayerNorm semantics (f32 compute, eps 1e-6), hand-rolled
-        # because the head runs on the raw pipeline output outside a module.
-        h = h.astype(jnp.float32)
-        mu = jnp.mean(h, axis=-1, keepdims=True)
-        var = jnp.var(h, axis=-1, keepdims=True)
-        hn = (h - mu) / jnp.sqrt(var + 1e-6)
-        return hn * rest["ln_f"]["scale"] + rest["ln_f"]["bias"]
+        # The shared flax-exact LayerNorm (parallel.megatron.layernorm) —
+        # the head runs on the raw pipeline output outside a module.
+        from mpit_tpu.parallel.megatron import layernorm
+
+        return layernorm(h, rest["ln_f"]["scale"], rest["ln_f"]["bias"])
 
     def _per_device_step(state: TrainState, batch):
         tokens = batch["tokens"]  # [b_local, T+1], replicated over pipe

@@ -1,0 +1,650 @@
+"""3-D parallelism: GPT-2 training steps composing three mesh axes.
+
+Round-1 verdict item 3: every tier composed with DP only — but a GPT-2
+config on the north-star hardware (32+ chips, BASELINE.json) needs
+data x model x pipe (and sequence) at once. Two jitted SPMD steps:
+
+- :func:`make_gpt2_dp_tp_pp_train_step` — ``data x model x pipe``:
+  Megatron-TP blocks (:func:`~mpit_tpu.parallel.megatron.
+  tp_transformer_block`, explicit collectives) as the stages of the
+  GPipe microbatch ring (:func:`~mpit_tpu.parallel.pipeline.
+  spmd_pipeline`), ZeRO-1 goo-state sharding over ``data`` inside each
+  (pipe, model) group.
+- :func:`make_gpt2_dp_cp_tp_train_step` — ``data x seq x model``:
+  TP blocks whose attention is the K/V ring over the sequence axis
+  (ring attention inside the Megatron block = TP inside CP), with the
+  context-parallel cross-shard next-token targets of ``parallel.cp``.
+
+Gradient-combine doctrine (load-bearing, learned the hard way in round
+2's broadcast-cotangent bug — see ``parallel/pp.py``): **vary a param
+over exactly the axes its grads are complete on.** Leaves varied over an
+axis get explicit reductions; leaves left replicated over an axis get
+their cotangents auto-psum'ed over it by VMA-aware AD — which is
+precisely the Megatron "g" operator for the TP-replicated LayerNorms
+(their gradient flows through *every* device's head shard, so the psum
+is required for correctness, not just retyping):
+
+| leaf group                      | varied over          | completion |
+|---------------------------------|----------------------|------------|
+| block kernels + col biases      | data, model(, pipe)  | none needed |
+| block LNs + row biases          | data(, pipe)         | AD psum over model |
+| embed/head/final-LN (``rest``)  | data                 | AD psum over model+pipe/seq |
+
+ZeRO-1 then reduce-scatters each group's flat grads over ``data`` (the
+per-placement-group ravel of ``parallel.pp``, one more group here).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mpit_tpu import opt as gopt
+from mpit_tpu.comm import collectives as C
+from mpit_tpu.models.gpt2 import GPT2Config
+from mpit_tpu.ops.lm_head import lm_head_xent
+from mpit_tpu.opt.sharded import state_partition_specs
+from mpit_tpu.parallel.megatron import (
+    layernorm,
+    repack_qkv,
+    tp_block_specs,
+    tp_transformer_block,
+)
+from mpit_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
+from mpit_tpu.parallel.pp import split_gpt2_params
+from mpit_tpu.parallel.ring_attention import ring_attention
+from mpit_tpu.train.step import TrainState
+
+# Model-sharded block leaves (everything else in a block is replicated
+# over the TP axis): the four matmul kernels plus the column-parallel
+# biases. Paths are "<module>/<param>" within one Block tree.
+_TP_SHARDED = {
+    "qkv/kernel", "qkv/bias", "fc/kernel", "fc/bias",
+    "proj/kernel", "out/kernel",
+}
+
+
+def _leaf_path(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "name", k))) for k in path)
+
+
+def _partition_block_tree(tree):
+    """Split one (possibly stacked) Block tree into (model-sharded,
+    model-replicated) subtrees, each keeping the full structure with
+    ``None`` holes so they can be re-merged leaf-wise."""
+
+    def pick(want_sharded):
+        def f(path, leaf):
+            return leaf if (_leaf_path(path) in _TP_SHARDED) == want_sharded else None
+
+        return jax.tree_util.tree_map_with_path(f, tree)
+
+    return pick(True), pick(False)
+
+
+def _merge(a, b):
+    """Overlay two complementary hole-trees (None marks a hole; exactly
+    one of the two holds each leaf). ``is_leaf`` makes the first tree's
+    None holes pair against the second tree's values."""
+    return jax.tree.map(
+        lambda x, y: x if x is not None else y, a, b,
+        is_leaf=lambda l: l is None,
+    )
+
+
+def _block_tree_specs(tree, model_axis, lead_axes):
+    """Specs for a stacked Block tree: TP placement per tp_block_specs,
+    the optional lead axis (pipe) sharding dim 0, stacked dims unsharded.
+    Stack depth is inferred from ln1/scale (rank 1 per block)."""
+    n_stack = tree["ln1"]["scale"].ndim - 1
+    base = tp_block_specs(model_axis, stack_dims=n_stack)
+    if not lead_axes:
+        return base
+
+    def prepend(spec):
+        return P(lead_axes[0], *tuple(spec)[1:])
+
+    return jax.tree.map(prepend, base)
+
+
+def _vary_block_tree(tree, *, data_axis, model_axis, extra_axes=()):
+    """Vary kernels over (data, model, *extra); replicated leaves over
+    (data, *extra) — per the module-docstring doctrine."""
+
+    def f(path, leaf):
+        axes = (data_axis, *extra_axes)
+        if _leaf_path(path) in _TP_SHARDED:
+            axes = (data_axis, model_axis, *extra_axes)
+        return C.vary(leaf, axes)
+
+    return jax.tree_util.tree_map_with_path(f, tree)
+
+
+def _final_norm(rest, h):
+    return layernorm(h, rest["ln_f"]["scale"], rest["ln_f"]["bias"])
+
+
+def _zero1_group(tx, grads, state, params, *, data_axis, mean_grads=True):
+    """One per-placement-group flat ZeRO-1 update (parallel/pp.py)."""
+    stx = gopt.sharded(tx, data_axis, mean_grads=mean_grads)
+    return stx.update(grads, state, params)
+
+
+def split_gpt2_params_3d(full_params, num_layers: int, n_pipe: int, n_model: int):
+    """``split_gpt2_params`` + per-stage :func:`repack_qkv` — the canonical
+    parameter layout of the dp x tp x pp tier. (``unpack_qkv`` restores
+    the dense checkpoint layout.)"""
+    split = split_gpt2_params(full_params, num_layers, n_pipe)
+    split["stages"] = repack_qkv(split["stages"], n_model)
+    return split
+
+
+def make_gpt2_dp_tp_pp_train_step(
+    cfg: GPT2Config,
+    tx: optax.GradientTransformation,
+    world,
+    *,
+    data_axis: str = "data",
+    model_axis: str = "model",
+    pipe_axis: str = "pipe",
+    num_microbatches: int = 4,
+    zero1: bool = True,
+    donate: bool = True,
+):
+    """GPT-2 training over a 3-D ``data x model x pipe`` mesh.
+
+    Params in :func:`split_gpt2_params_3d` layout; batch
+    ``{"tokens": [B_global, T+1]}`` sharded ``P(data_axis)``. Requires
+    untied head (PP), ``num_layers % n_pipe == 0``,
+    ``num_heads % n_model == 0``, per-device batch divisible by
+    ``num_microbatches``. ZeRO-1 shards goo state over ``data`` within
+    each (pipe, model) group — three flat groups by placement.
+    """
+    if cfg.tie_head:
+        raise ValueError("the 3-D tier requires GPT2Config(tie_head=False)")
+    n_pipe = world.axis_size(pipe_axis)
+    n_model = world.axis_size(model_axis)
+    if cfg.num_layers % n_pipe:
+        raise ValueError(
+            f"num_layers ({cfg.num_layers}) must divide by pipe={n_pipe}"
+        )
+    if cfg.num_heads % n_model:
+        raise ValueError(
+            f"num_heads ({cfg.num_heads}) must divide by model={n_model}"
+        )
+
+    def stage_fn(stage_params, x):
+        def body(h, p):
+            return (
+                tp_transformer_block(
+                    p, h, num_heads=cfg.num_heads, axis=model_axis,
+                    dtype=cfg.dtype,
+                ),
+                None,
+            )
+
+        y, _ = lax.scan(body, x, stage_params)
+        return y
+
+    def _stage_specs(split):
+        return _block_tree_specs(split["stages"], model_axis, (pipe_axis,))
+
+    def _split_specs(split):
+        return {
+            "stages": _stage_specs(split),
+            "rest": jax.tree.map(lambda _: P(), split["rest"]),
+        }
+
+    def _local_view(split):
+        return {
+            "stages": jax.tree.map(lambda l: l[0], split["stages"]),
+            "rest": split["rest"],
+        }
+
+    def _groups(local):
+        """(sharded-stage, replicated-stage, rest) — the three placement
+        groups, each a full-structure tree with None holes."""
+        g_sh, g_rep = _partition_block_tree(local["stages"])
+        return g_sh, g_rep, local["rest"]
+
+    def _opt_specs(split_params):
+        if not zero1:
+            # State mirrors the local params per leaf: stage-leaf state is
+            # pipe-stacked on dim 0 AND carries the leaf's TP placement
+            # (kernels are model-sharded); scalars replicated.
+            local = jax.eval_shape(_local_view, split_params)
+            shapes = jax.eval_shape(tx.init, local)
+            base = tp_block_specs(model_axis)
+
+            def spec_for(path, leaf):
+                if getattr(leaf, "ndim", 0) == 0:
+                    return P()
+                parts = _leaf_path(path).split("/")
+                if "stages" not in parts:
+                    return P()
+                module, param = parts[-2], parts[-1]
+                return P(pipe_axis, *tuple(base[module][param]))
+
+            return jax.tree_util.tree_map_with_path(spec_for, shapes)
+        local = jax.eval_shape(_local_view, split_params)
+        g_sh, g_rep, rest = _groups(local)
+
+        def flat_specs(tree, axes):
+            # None holes are empty pytree nodes: ravel/init skip them.
+            specs = state_partition_specs(
+                tx, tree, world.axis_size(data_axis), data_axis
+            )
+            return jax.tree.map(
+                lambda s: P(axes) if s == P(data_axis) else s, specs
+            )
+
+        return {
+            "tp_sharded": flat_specs(
+                g_sh, (pipe_axis, model_axis, data_axis)
+            ),
+            "tp_replicated": flat_specs(g_rep, (pipe_axis, data_axis)),
+            "rest": flat_specs(rest, (data_axis,)),
+        }
+
+    def state_specs(split_params, extra=()):
+        del extra
+        return TrainState(
+            step=P(),
+            params=_split_specs(split_params),
+            opt_state=_opt_specs(split_params),
+            extra=(),
+        )
+
+    def _per_device_init(split):
+        local = _local_view(split)
+        if zero1:
+            g_sh, g_rep, rest = _groups(local)
+            stx = gopt.sharded(tx, data_axis)
+            opt_state = {
+                "tp_sharded": stx.init(g_sh),
+                "tp_replicated": stx.init(g_rep),
+                "rest": stx.init(rest),
+            }
+        else:
+            opt_state = tx.init(local)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=split,
+            opt_state=opt_state,
+            extra=(),
+        )
+
+    def init_fn(split_params, extra=()) -> TrainState:
+        del extra
+        f = world.shard_map(
+            _per_device_init,
+            in_specs=(_split_specs(split_params),),
+            out_specs=state_specs(split_params),
+        )
+        return jax.jit(f)(split_params)
+
+    def _per_device_step(state: TrainState, batch):
+        tokens = batch["tokens"]
+        inp, targets = tokens[:, :-1], tokens[:, 1:]
+        b, t = inp.shape
+        m = num_microbatches
+        if b % m:
+            raise ValueError(
+                f"per-device batch ({b}) must divide by num_microbatches ({m})"
+            )
+
+        # Vary per the doctrine: stage kernels over (data, model, pipe);
+        # stage LNs/row-biases over (data, pipe); rest over (data) only —
+        # AD auto-psums the unvaried axes' cotangents (module docstring).
+        local_stages = _vary_block_tree(
+            state.params["stages"],
+            data_axis=data_axis,
+            model_axis=model_axis,
+            extra_axes=(pipe_axis,),
+        )
+        rest = C.vary(state.params["rest"], data_axis)
+
+        def loss_fn(local_stages, rest):
+            x = rest["wte"][inp].astype(cfg.dtype) + rest["wpe"][:t].astype(
+                cfg.dtype
+            )
+            xm = x.reshape(m, b // m, t, x.shape[-1])
+            ym = spmd_pipeline(
+                stage_fn,
+                local_stages,
+                xm,
+                axis=pipe_axis,
+                broadcast_outputs=False,
+            )
+            h = ym.reshape(b, t, x.shape[-1])
+            losses = lm_head_xent(
+                _final_norm(rest, h),
+                rest["head"],
+                targets,
+                compute_dtype=cfg.head_dtype,
+            )
+            is_last = C.rank(pipe_axis) == n_pipe - 1
+            return jnp.where(is_last, jnp.mean(losses), 0.0)
+
+        loss, (g_stages, g_rest) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1)
+        )(local_stages, rest)
+        # Completion status on arrival: stage kernels complete per device;
+        # stage LNs psum'ed over model by AD; rest psum'ed over model AND
+        # pipe by AD. The loss needs the explicit pipe psum (it was
+        # masked, not differentiated-through-broadcast).
+        loss = lax.psum(loss, pipe_axis)
+        # Grads mirror the [1, k, ...] sharded-leading-dim view; drop it
+        # to match the local view the optimizer updates.
+        g_stages = jax.tree.map(lambda l: l[0], g_stages)
+
+        local_params = _local_view(state.params)
+        if zero1:
+            g_sh, g_rep = _partition_block_tree(g_stages)
+            p_sh, p_rep = _partition_block_tree(local_params["stages"])
+            u_sh, st_sh = _zero1_group(
+                tx, g_sh, state.opt_state["tp_sharded"], p_sh,
+                data_axis=data_axis,
+            )
+            u_rep, st_rep = _zero1_group(
+                tx, g_rep, state.opt_state["tp_replicated"], p_rep,
+                data_axis=data_axis,
+            )
+            u_rest, st_rest = _zero1_group(
+                tx, g_rest, state.opt_state["rest"], local_params["rest"],
+                data_axis=data_axis,
+            )
+            updates = {"stages": _merge(u_sh, u_rep), "rest": u_rest}
+            opt_state = {
+                "tp_sharded": st_sh,
+                "tp_replicated": st_rep,
+                "rest": st_rest,
+            }
+        else:
+            local_grads = jax.tree.map(
+                lambda g: lax.pmean(g, data_axis),
+                {"stages": g_stages, "rest": g_rest},
+            )
+            updates, opt_state = tx.update(
+                local_grads, state.opt_state, local_params
+            )
+        new_local = optax.apply_updates(local_params, updates)
+        new_params = {
+            "stages": jax.tree.map(lambda l: l[None], new_local["stages"]),
+            "rest": new_local["rest"],
+        }
+        # loss arrives model-varying (typed); identical values — retype.
+        metrics = {
+            "loss": lax.pmean(lax.pmean(loss, model_axis), data_axis)
+        }
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=opt_state,
+                extra=(),
+            ),
+            metrics,
+        )
+
+    compiled: dict = {}
+
+    def step_fn(state: TrainState, batch):
+        key = jax.tree_util.tree_structure(state.params)
+        f = compiled.get(key)
+        if f is None:
+            specs = state_specs(state.params)
+            f = jax.jit(
+                world.shard_map(
+                    _per_device_step,
+                    in_specs=(specs, P(data_axis)),
+                    out_specs=(specs, P()),
+                ),
+                donate_argnums=(0,) if donate else (),
+            )
+            compiled[key] = f
+        return f(state, batch)
+
+    return init_fn, step_fn, state_specs
+
+
+def stack_gpt2_blocks(full_params, num_layers: int, n_model: int):
+    """GPT2 params → ``{"blocks": [L, ...] repacked, "rest": {...}}`` —
+    the dp x cp x tp tier's layout (all blocks on every device)."""
+    blocks = stack_stage_params(
+        [full_params[f"block_{i}"] for i in range(num_layers)]
+    )
+    rest = {
+        k: v for k, v in full_params.items() if not k.startswith("block_")
+    }
+    return {"blocks": repack_qkv(blocks, n_model), "rest": rest}
+
+
+def make_gpt2_dp_cp_tp_train_step(
+    cfg: GPT2Config,
+    tx: optax.GradientTransformation,
+    world,
+    *,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+    model_axis: str = "model",
+    zero1: bool = True,
+    donate: bool = True,
+):
+    """GPT-2 training over ``data x seq x model``: ring attention (CP)
+    INSIDE the Megatron-TP block — the round-1 verdict's "TP inside CP".
+
+    Params in :func:`stack_gpt2_blocks` layout; batch
+    ``{"tokens": [B_global, T_global]}`` sharded ``P(data, seq)`` (use
+    ``shard_batch(world, batch, spec=P('data', 'seq'))``). Cross-shard
+    next-token targets exactly as ``parallel.cp``; the loss is globally
+    normalized, so the data-axis reduction uses SUM semantics.
+    """
+    n_seq = world.axis_size(seq_axis)
+    n_model = world.axis_size(model_axis)
+    if cfg.num_heads % n_model:
+        raise ValueError(
+            f"num_heads ({cfg.num_heads}) must divide by model={n_model}"
+        )
+
+    attention_fn = partial(ring_attention, axis=seq_axis)
+
+    def _specs(params):
+        return {
+            "blocks": _block_tree_specs(params["blocks"], model_axis, ()),
+            "rest": jax.tree.map(lambda _: P(), params["rest"]),
+        }
+
+    def _opt_specs(params):
+        if not zero1:
+            # State mirrors the params placement: block-kernel state is
+            # model-sharded like its param; scalars/rest replicated.
+            shapes = jax.eval_shape(tx.init, params)
+            base = tp_block_specs(model_axis)
+
+            def spec_for(path, leaf):
+                if getattr(leaf, "ndim", 0) == 0:
+                    return P()
+                parts = _leaf_path(path).split("/")
+                if "blocks" not in parts:
+                    return P()
+                module, param = parts[-2], parts[-1]
+                return P(None, *tuple(base[module][param]))
+
+            return jax.tree_util.tree_map_with_path(spec_for, shapes)
+        g_sh, g_rep = _partition_block_tree(params["blocks"])
+
+        def flat_specs(tree, axes):
+            specs = state_partition_specs(
+                tx, tree, world.axis_size(data_axis), data_axis
+            )
+            return jax.tree.map(
+                lambda s: P(axes) if s == P(data_axis) else s, specs
+            )
+
+        return {
+            "tp_sharded": flat_specs(g_sh, (model_axis, data_axis)),
+            "tp_replicated": flat_specs(g_rep, (data_axis,)),
+            "rest": flat_specs(params["rest"], (data_axis,)),
+        }
+
+    def state_specs(params, extra=()):
+        del extra
+        return TrainState(
+            step=P(),
+            params=_specs(params),
+            opt_state=_opt_specs(params),
+            extra=(),
+        )
+
+    def _per_device_init(params):
+        if zero1:
+            g_sh, g_rep = _partition_block_tree(params["blocks"])
+            stx = gopt.sharded(tx, data_axis)
+            opt_state = {
+                "tp_sharded": stx.init(g_sh),
+                "tp_replicated": stx.init(g_rep),
+                "rest": stx.init(params["rest"]),
+            }
+        else:
+            opt_state = tx.init(params)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            extra=(),
+        )
+
+    def init_fn(params, extra=()) -> TrainState:
+        del extra
+        f = world.shard_map(
+            _per_device_init,
+            in_specs=(_specs(params),),
+            out_specs=state_specs(params),
+        )
+        return jax.jit(f)(params)
+
+    def _per_device_step(state: TrainState, batch):
+        tokens = batch["tokens"]  # [b_local, t_local]
+        t_local = tokens.shape[1]
+        sidx = C.rank(seq_axis)
+        positions = C.vary(
+            sidx * t_local + jnp.arange(t_local, dtype=jnp.int32), data_axis
+        )
+        next_first = C.shift(tokens[:, :1], seq_axis, offset=-1)
+        targets = jnp.concatenate([tokens[:, 1:], next_first], axis=1)
+        mask = C.vary(
+            jnp.broadcast_to(
+                jnp.where(
+                    (sidx == n_seq - 1)
+                    & (jnp.arange(t_local) == t_local - 1),
+                    0.0,
+                    1.0,
+                ),
+                targets.shape,
+            ),
+            data_axis,
+        )
+        count = C.allreduce(jnp.sum(mask), (data_axis, seq_axis))
+
+        # Vary doctrine (module docstring): kernels over (data, model);
+        # LNs/row-biases and rest over (data) only — AD auto-psums their
+        # cotangents over model AND seq (params are seq-replicated and
+        # the loss is seq-local).
+        blocks = _vary_block_tree(
+            state.params["blocks"], data_axis=data_axis, model_axis=model_axis
+        )
+        rest = C.vary(state.params["rest"], data_axis)
+
+        def loss_fn(blocks, rest):
+            x = rest["wte"][tokens].astype(cfg.dtype) + rest["wpe"][
+                positions
+            ].astype(cfg.dtype)
+
+            def body(h, p):
+                return (
+                    tp_transformer_block(
+                        p, h, num_heads=cfg.num_heads, axis=model_axis,
+                        attention_fn=attention_fn, dtype=cfg.dtype,
+                    ),
+                    None,
+                )
+
+            h, _ = lax.scan(body, x, blocks)
+            head = rest["wte"] if cfg.tie_head else rest["head"]
+            losses = lm_head_xent(
+                _final_norm(rest, h), head, targets,
+                compute_dtype=cfg.head_dtype,
+            )
+            return jnp.sum(losses * mask) / count
+
+        loss_local, (g_blocks, g_rest) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1)
+        )(blocks, rest)
+
+        local_params = state.params
+        if zero1:
+            # SUM semantics over data: the loss is already globally
+            # normalized by `count` (parallel.cp convention).
+            g_sh, g_rep = _partition_block_tree(g_blocks)
+            p_sh, p_rep = _partition_block_tree(local_params["blocks"])
+            u_sh, st_sh = _zero1_group(
+                tx, g_sh, state.opt_state["tp_sharded"], p_sh,
+                data_axis=data_axis, mean_grads=False,
+            )
+            u_rep, st_rep = _zero1_group(
+                tx, g_rep, state.opt_state["tp_replicated"], p_rep,
+                data_axis=data_axis, mean_grads=False,
+            )
+            u_rest, st_rest = _zero1_group(
+                tx, g_rest, state.opt_state["rest"], local_params["rest"],
+                data_axis=data_axis, mean_grads=False,
+            )
+            updates = {"blocks": _merge(u_sh, u_rep), "rest": u_rest}
+            opt_state = {
+                "tp_sharded": st_sh,
+                "tp_replicated": st_rep,
+                "rest": st_rest,
+            }
+        else:
+            grads = jax.tree.map(
+                lambda g: lax.psum(g, data_axis),
+                {"blocks": g_blocks, "rest": g_rest},
+            )
+            updates, opt_state = tx.update(grads, state.opt_state, local_params)
+        params = optax.apply_updates(local_params, updates)
+
+        loss = lax.psum(loss_local, (data_axis, seq_axis))
+        metrics = {"loss": lax.pmean(loss, model_axis)}
+        return (
+            TrainState(
+                step=state.step + 1, params=params, opt_state=opt_state,
+                extra=(),
+            ),
+            metrics,
+        )
+
+    compiled: dict = {}
+
+    def step_fn(state: TrainState, batch):
+        key = jax.tree_util.tree_structure(state.params)
+        f = compiled.get(key)
+        if f is None:
+            specs = state_specs(state.params)
+            f = jax.jit(
+                world.shard_map(
+                    _per_device_step,
+                    in_specs=(specs, P(data_axis, seq_axis)),
+                    out_specs=(specs, P()),
+                ),
+                donate_argnums=(0,) if donate else (),
+            )
+            compiled[key] = f
+        return f(state, batch)
+
+    return init_fn, step_fn, state_specs

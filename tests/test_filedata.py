@@ -1,0 +1,184 @@
+"""File-based dataset (data/filedata.py): the --data-dir real-data path.
+
+The reference trains from on-disk MNIST/ImageNet; these tests build tiny
+on-disk fixtures (no network) and prove the same workloads run unchanged
+against them (round-1 verdict item 5)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mpit_tpu.data import (
+    FileClassification,
+    FileLM,
+    load_dataset,
+    write_classification,
+    write_lm,
+)
+
+
+def _cls_fixture(tmp_path, n=64, img=(8, 8, 1), classes=4, dtype=np.uint8):
+    rng = np.random.RandomState(0)
+    protos = rng.randint(0, 255, size=(classes, *img)).astype(np.float32)
+    labels = rng.randint(0, classes, size=n)
+    images = np.clip(
+        protos[labels] + rng.randn(n, *img) * 8, 0, 255
+    ).astype(dtype)
+    d = write_classification(
+        str(tmp_path / "ds"), images, labels, num_classes=classes
+    )
+    # small val split
+    vlabels = rng.randint(0, classes, size=16)
+    vimages = np.clip(
+        protos[vlabels] + rng.randn(16, *img) * 8, 0, 255
+    ).astype(dtype)
+    write_classification(d, vimages, vlabels, split="val", num_classes=classes)
+    return d, images, labels
+
+
+class TestFileClassification:
+    def test_roundtrip_and_meta(self, tmp_path):
+        d, images, labels = _cls_fixture(tmp_path)
+        ds = load_dataset(d)
+        assert isinstance(ds, FileClassification)
+        assert ds.num_classes == 4
+        assert len(ds) == 64
+        assert ds.image_shape == (8, 8, 1)
+
+    def test_batches_normalized_and_epoch_shuffled(self, tmp_path):
+        d, images, labels = _cls_fixture(tmp_path)
+        ds = FileClassification(d, seed=3)
+        it = ds.batches(16)
+        seen = []
+        for _ in range(4):  # one full epoch
+            b = next(it)
+            assert b["image"].shape == (16, 8, 8, 1)
+            assert b["image"].dtype == np.float32
+            assert b["label"].dtype == np.int32
+            assert float(b["image"].max()) <= 1.0  # uint8 normalized
+            seen.append(b["label"])
+        # one epoch covers each sample once (labels multiset matches)
+        got = np.sort(np.concatenate(seen))
+        assert np.array_equal(got, np.sort(labels))
+        # determinism: same seed -> same stream
+        again = next(FileClassification(d, seed=3).batches(16))
+        np.testing.assert_array_equal(again["label"], seen[0])
+
+    def test_eval_batch_uses_val_split(self, tmp_path):
+        d, _, _ = _cls_fixture(tmp_path)
+        ds = FileClassification(d)
+        ev = ds.eval_batch(8)
+        assert ev["image"].shape == (8, 8, 8, 1)
+        # val split has 16 rows; asking for more clamps
+        assert ds.eval_batch(64)["image"].shape[0] == 16
+
+    def test_rejects_oversized_batch_and_bad_kind(self, tmp_path):
+        d, _, _ = _cls_fixture(tmp_path)
+        with pytest.raises(ValueError, match="exceeds"):
+            next(FileClassification(d).batches(1000))
+        lm_dir = write_lm(str(tmp_path / "lm"), np.arange(100) % 7)
+        with pytest.raises(ValueError, match="expected 'classification'"):
+            FileClassification(lm_dir)
+
+
+class TestFileLM:
+    def test_windows_and_meta(self, tmp_path):
+        tokens = np.arange(1000) % 11
+        d = write_lm(str(tmp_path / "lm"), tokens, vocab_size=11)
+        ds = load_dataset(d)
+        assert isinstance(ds, FileLM)
+        b = next(ds.batches(4, 16))
+        assert b["tokens"].shape == (4, 17)
+        assert b["tokens"].dtype == np.int32
+        # windows are contiguous slices of the stream
+        for row in b["tokens"]:
+            start = row[0] + 11 * 0  # stream is arange % 11; check deltas
+            assert np.array_equal(np.diff(row) % 11, np.ones(16))
+        assert ds.uniform_loss == pytest.approx(np.log(11))
+
+    def test_eval_prefers_val_split(self, tmp_path):
+        d = write_lm(str(tmp_path / "lm"), np.zeros(100, np.int32), vocab_size=5)
+        write_lm(d, np.ones(100, np.int32), split="val", vocab_size=5)
+        ds = FileLM(d)
+        assert int(ds.eval_batch(2, 8)["tokens"].sum()) == 2 * 9
+        assert int(next(ds.batches(2, 8))["tokens"].sum()) == 0
+
+    def test_short_stream_raises(self, tmp_path):
+        d = write_lm(str(tmp_path / "s"), np.arange(10), vocab_size=10)
+        with pytest.raises(ValueError, match="shorter"):
+            next(FileLM(d).batches(2, 32))
+
+
+class TestWorkloadIntegration:
+    def test_mnist_app_trains_from_disk(self, tmp_path):
+        """Baseline config #1 shape, real-data path: LeNet learns the
+        on-disk prototype dataset via --data-dir."""
+        rng = np.random.RandomState(0)
+        protos = rng.randint(40, 215, size=(10, 28, 28, 1)).astype(np.float32)
+        labels = rng.randint(0, 10, size=256)
+        images = np.clip(
+            protos[labels] + rng.randn(256, 28, 28, 1) * 12, 0, 255
+        ).astype(np.uint8)
+        d = write_classification(
+            str(tmp_path / "mnist"), images, labels, num_classes=10
+        )
+
+        from mpit_tpu.asyncsgd import mnist as app
+
+        out = app.main(
+            ["--data-dir", d, "--steps", "80", "--batch-size", "64",
+             "--lr", "0.05", "--log-every", "40", "--eval-batch", "64"]
+        )
+        assert out["eval"]["accuracy"] > 0.9
+
+    def test_mnist_app_rejects_wrong_geometry(self, tmp_path):
+        d, _, _ = _cls_fixture(tmp_path)  # 8x8 images
+        from mpit_tpu.asyncsgd import mnist as app
+
+        with pytest.raises(SystemExit, match="expects"):
+            app.main(["--data-dir", d, "--steps", "1"])
+
+    def test_gpt2_app_trains_from_disk(self, tmp_path):
+        """LM real-data path: bigram-structured token file; loss falls
+        below the uniform baseline."""
+        rng = np.random.RandomState(0)
+        succ = rng.randint(0, 64, size=(64, 2)).astype(np.int32)
+        toks = np.empty(4096, np.int32)
+        toks[0] = 1
+        for i in range(1, len(toks)):
+            toks[i] = succ[toks[i - 1], rng.randint(2)]
+        d = write_lm(str(tmp_path / "lm"), toks, vocab_size=64)
+
+        from mpit_tpu.asyncsgd import gpt2 as app
+
+        out = app.main(
+            ["--data-dir", d, "--steps", "25", "--batch-size", "8",
+             "--seq-len", "32", "--num-layers", "2", "--num-heads", "2",
+             "--d-model", "32", "--lr", "0.003", "--log-every", "25"]
+        )
+        assert out["final_loss"] < out["uniform_loss"]
+
+
+class TestMetaMerge:
+    def test_val_split_cannot_shrink_inferred_geometry(self, tmp_path):
+        """A val split whose labels miss the top classes must not shrink
+        num_classes (round-2 review finding): inferred geometry only
+        grows; explicit values still override."""
+        import numpy as np
+        from mpit_tpu.data import FileClassification, write_classification, write_lm, FileLM
+
+        d = str(tmp_path / "ds")
+        imgs = np.zeros((10, 4, 4, 1), np.uint8)
+        write_classification(d, imgs, np.arange(10))  # infers 10
+        write_classification(d, imgs[:4], np.arange(4), split="val")  # max label 3
+        assert FileClassification(d).num_classes == 10
+        # explicit override still wins
+        write_classification(d, imgs, np.arange(10), num_classes=12)
+        assert FileClassification(d).num_classes == 12
+
+        lm = str(tmp_path / "lm")
+        write_lm(lm, np.arange(64) % 50)  # infers 50
+        write_lm(lm, np.zeros(64, np.int32), split="val")  # max token 0
+        assert FileLM(lm).vocab_size == 50

@@ -963,3 +963,386 @@ class TestPerLeafGradientParity:
             state.params,
             ref,
         )
+
+
+class TestMegatronBlock:
+    """Full-block Megatron TP/SP (round-2 item 10): tp_transformer_block
+    vs the flax Block, exact numerics."""
+
+    def _setup(self):
+        from mpit_tpu.parallel import repack_qkv, unpack_qkv
+
+        cfg = GPT2Config.tiny(num_heads=8, d_model=32, dtype=jnp.float32)
+        from mpit_tpu.models.gpt2 import Block
+
+        block = Block(cfg)
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(2, 16, 32).astype(np.float32)
+        )
+        params = block.init(jax.random.key(0), x)["params"]
+        ref = block.apply({"params": params}, x)
+        packed = repack_qkv(params, 8)
+        # repack/unpack is a true inverse
+        rt = unpack_qkv(packed, 8)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            rt,
+            params,
+        )
+        return packed, x, ref
+
+    def test_tp_block_matches_flax_block(self):
+        from mpit_tpu.parallel import tp_block_specs, tp_transformer_block
+
+        packed, x, ref = self._setup()
+        world = comm.init({"model": 8}, set_default=False)
+        f = world.shard_map(
+            lambda p, x: tp_transformer_block(
+                p, x, num_heads=8, dtype=jnp.float32
+            ),
+            in_specs=(tp_block_specs("model"), P()),
+            out_specs=P(),
+        )
+        got = jax.jit(f)(packed, x)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=2e-5
+        )
+
+    def test_sequence_parallel_block_matches(self):
+        """Megatron-SP: residual stream and LayerNorms stay sequence-
+        sharded; all-gather/reduce-scatter bound each TP region."""
+        from mpit_tpu.parallel import tp_block_specs, tp_transformer_block
+
+        packed, x, ref = self._setup()
+        world = comm.init({"model": 8}, set_default=False)
+        f = world.shard_map(
+            lambda p, x: tp_transformer_block(
+                p, x, num_heads=8, dtype=jnp.float32, sequence_parallel=True
+            ),
+            in_specs=(tp_block_specs("model"), P(None, "model")),
+            out_specs=P(None, "model"),
+        )
+        got = jax.jit(f)(packed, x)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=2e-5
+        )
+
+    def test_rejects_indivisible_heads(self):
+        from mpit_tpu.parallel import tp_block_specs, tp_transformer_block
+
+        packed, x, _ = self._setup()
+        world = comm.init({"model": 8}, set_default=False)
+        f = world.shard_map(
+            lambda p, x: tp_transformer_block(
+                p, x, num_heads=6, dtype=jnp.float32
+            ),
+            in_specs=(tp_block_specs("model"), P()),
+            out_specs=P(),
+        )
+        with pytest.raises(ValueError, match="divide"):
+            jax.jit(f)(packed, x)
+
+
+class Test3DComposition:
+    """Round-2 item 3: data x model x pipe (and TP inside CP) in one
+    jitted step, trajectory-exact vs single-device AD."""
+
+    def _ref_step(self, model, full, loss_fn, tx):
+        import optax
+
+        _, g = jax.value_and_grad(loss_fn)(full)
+        up, _ = tx.update(g, tx.init(full), full)
+        return optax.apply_updates(full, up)
+
+    @pytest.mark.parametrize("zero1", [False, True])
+    def test_dp_tp_pp_matches_single_device(self, zero1):
+        import mpit_tpu
+        from mpit_tpu.data import shard_batch
+        from mpit_tpu.opt import goo
+        from mpit_tpu.parallel import (
+            make_gpt2_dp_tp_pp_train_step,
+            split_gpt2_params_3d,
+        )
+
+        cfg = GPT2Config.tiny(
+            num_heads=4, max_seq_len=64, num_layers=4, tie_head=False,
+            dtype=jnp.float32,
+        )
+        model = GPT2(cfg)
+        full = jax.jit(model.init)(
+            jax.random.key(0), jnp.zeros((1, 64), jnp.int32)
+        )["params"]
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 512, size=(8, 65)).astype(
+                np.int32
+            )
+        )
+
+        def ref_loss(p):
+            return jnp.mean(
+                model.apply({"params": p}, toks[:, :-1], targets=toks[:, 1:])
+            )
+
+        ref = split_gpt2_params_3d(
+            self._ref_step(model, full, ref_loss, goo(0.05, 0.9)),
+            cfg.num_layers, 2, 2,
+        )
+        world = mpit_tpu.init(
+            {"data": 2, "model": 2, "pipe": 2}, set_default=False
+        )
+        split = split_gpt2_params_3d(full, cfg.num_layers, 2, 2)
+        init_fn, step_fn, _ = make_gpt2_dp_tp_pp_train_step(
+            cfg, goo(0.05, 0.9), world, num_microbatches=4, zero1=zero1
+        )
+        state, m = step_fn(
+            init_fn(split), shard_batch(world, {"tokens": toks})
+        )
+        assert np.isfinite(float(m["loss"]))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+            ),
+            state.params,
+            ref,
+        )
+
+    @pytest.mark.parametrize("zero1", [False, True])
+    def test_dp_cp_tp_matches_single_device(self, zero1):
+        """Ring attention INSIDE the Megatron block: TP x CP."""
+        import mpit_tpu
+        from mpit_tpu.data import shard_batch
+        from mpit_tpu.opt import goo
+        from mpit_tpu.parallel import (
+            make_gpt2_dp_cp_tp_train_step,
+            stack_gpt2_blocks,
+        )
+
+        cfg = GPT2Config.tiny(
+            num_heads=4, max_seq_len=64, num_layers=2, dtype=jnp.float32
+        )
+        model = GPT2(cfg)
+        full = jax.jit(model.init)(
+            jax.random.key(0), jnp.zeros((1, 64), jnp.int32)
+        )["params"]
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 512, size=(4, 64)).astype(
+                np.int32
+            )
+        )
+
+        def ref_loss(p):
+            losses = model.apply(
+                {"params": p}, toks,
+                targets=jnp.pad(toks[:, 1:], ((0, 0), (0, 1))),
+            )
+            return jnp.sum(losses[:, :-1]) / (
+                toks.shape[0] * (toks.shape[1] - 1)
+            )
+
+        ref = stack_gpt2_blocks(
+            self._ref_step(model, full, ref_loss, goo(0.05, 0.9)),
+            cfg.num_layers, 2,
+        )
+        world = mpit_tpu.init(
+            {"data": 2, "seq": 2, "model": 2}, set_default=False
+        )
+        stacked = stack_gpt2_blocks(full, cfg.num_layers, 2)
+        init_fn, step_fn, _ = make_gpt2_dp_cp_tp_train_step(
+            cfg, goo(0.05, 0.9), world, zero1=zero1
+        )
+        state, m = step_fn(
+            init_fn(stacked),
+            shard_batch(world, {"tokens": toks}, spec=P("data", "seq")),
+        )
+        assert np.isfinite(float(m["loss"]))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+            ),
+            state.params,
+            ref,
+        )
+
+    def test_zero1_state_is_sharded_per_group(self):
+        """Flat goo-state vectors are genuinely sharded per placement
+        group (the north-star under 3-D composition)."""
+        import mpit_tpu
+        from mpit_tpu.opt import goo_adam
+        from mpit_tpu.parallel import (
+            make_gpt2_dp_tp_pp_train_step,
+            split_gpt2_params_3d,
+        )
+
+        cfg = GPT2Config.tiny(
+            num_heads=4, max_seq_len=32, num_layers=4, tie_head=False
+        )
+        model = GPT2(cfg)
+        full = jax.jit(model.init)(
+            jax.random.key(0), jnp.zeros((1, 32), jnp.int32)
+        )["params"]
+        world = mpit_tpu.init(
+            {"data": 2, "model": 2, "pipe": 2}, set_default=False
+        )
+        split = split_gpt2_params_3d(full, cfg.num_layers, 2, 2)
+        init_fn, _, _ = make_gpt2_dp_tp_pp_train_step(
+            cfg, goo_adam(1e-3), world, zero1=True
+        )
+        state = init_fn(split)
+        vec = [
+            l for l in jax.tree.leaves(state.opt_state)
+            if getattr(l, "ndim", 0) == 1 and l.size > 1
+        ]
+        assert vec
+        for l in vec:
+            axes = [
+                a for part in l.sharding.spec if part is not None
+                for a in ((part,) if isinstance(part, str) else part)
+            ]
+            factor = int(np.prod([world.mesh.shape[a] for a in axes]))
+            assert factor >= world.axis_size("data"), l.sharding.spec
+            shard = next(iter(l.addressable_shards))
+            assert shard.data.size * factor == l.size
+
+
+class TestExpertParallelTier:
+    """Round-2 item 6: the EP training tier (parallel.ep) — the round-1
+    MoE dispatch shelf turned into a usable strategy."""
+
+    def _setup(self, capacity_factor=4.0):
+        import mpit_tpu
+        from mpit_tpu.models.gpt2_moe import GPT2MoE, MoESettings
+
+        cfg = GPT2Config.tiny(
+            num_heads=2, max_seq_len=32, num_layers=2, dtype=jnp.float32
+        )
+        moe = MoESettings(
+            num_experts=8, k=2, capacity_factor=capacity_factor, every=2
+        )
+        model = GPT2MoE(cfg, moe)
+        full = jax.jit(model.init)(
+            jax.random.key(0), jnp.zeros((1, 32), jnp.int32)
+        )["params"]
+        world = mpit_tpu.init({"data": 2, "expert": 4}, set_default=False)
+        return cfg, moe, model, full, world
+
+    @pytest.mark.parametrize("zero1", [False, True])
+    def test_dense_parity_in_ample_capacity(self, zero1):
+        """With ample capacity (no drops) and aux_weight=0, one EP step
+        equals the dense single-device step exactly."""
+        import optax
+        from mpit_tpu.data import shard_batch
+        from mpit_tpu.opt import goo
+        from mpit_tpu.parallel import make_gpt2_moe_train_step
+
+        cfg, moe, model, full, world = self._setup()
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 512, size=(8, 33)).astype(
+                np.int32
+            )
+        )
+
+        tx = goo(0.05, 0.9)
+
+        def ref_loss(p):
+            losses, _ = model.apply(
+                {"params": p}, toks[:, :-1], targets=toks[:, 1:]
+            )
+            return jnp.mean(losses)
+
+        _, g = jax.value_and_grad(ref_loss)(full)
+        up, _ = tx.update(g, tx.init(full), full)
+        ref = optax.apply_updates(full, up)
+
+        init_fn, step_fn, _ = make_gpt2_moe_train_step(
+            cfg, moe, goo(0.05, 0.9), world, aux_weight=0.0, zero1=zero1
+        )
+        state, m = step_fn(
+            init_fn(full),
+            shard_batch(world, {"tokens": toks}, spec=P(("data", "expert"))),
+        )
+        np.testing.assert_allclose(
+            float(m["loss"]), float(ref_loss(full)), rtol=2e-5
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+            ),
+            state.params,
+            ref,
+        )
+
+    def test_loss_decreases_with_aux(self):
+        from mpit_tpu.data import SyntheticLM, shard_batch
+        from mpit_tpu.opt import goo_adam
+        from mpit_tpu.parallel import make_gpt2_moe_train_step
+
+        cfg, moe, model, full, world = self._setup(capacity_factor=1.25)
+        init_fn, step_fn, _ = make_gpt2_moe_train_step(
+            cfg, moe, goo_adam(3e-3), world, aux_weight=0.01, zero1=True
+        )
+        state = init_fn(full)
+        stream = SyntheticLM(vocab_size=cfg.vocab_size, seed=0).batches(8, 32)
+        losses, auxes = [], []
+        for _ in range(10):
+            batch = shard_batch(
+                world, next(stream), spec=P(("data", "expert"))
+            )
+            state, m = step_fn(state, batch)
+            losses.append(float(m["loss"]))
+            auxes.append(float(m["aux"]))
+        assert losses[-1] < losses[0], losses
+        assert all(np.isfinite(auxes)), auxes
+
+    def test_composes_with_checkpointing(self, tmp_path):
+        """Save mid-run, restore into a fresh state, trajectories match —
+        the tier's state_specs drive the sharded orbax restore."""
+        from mpit_tpu.data import SyntheticLM, shard_batch
+        from mpit_tpu.opt import goo_adam
+        from mpit_tpu.parallel import make_gpt2_moe_train_step
+        from mpit_tpu.train import CheckpointManager
+
+        cfg, moe, model, full, world = self._setup()
+        init_fn, step_fn, specs_fn = make_gpt2_moe_train_step(
+            cfg, moe, goo_adam(1e-3), world, zero1=True
+        )
+        state = init_fn(full)
+        stream = SyntheticLM(vocab_size=cfg.vocab_size, seed=0).batches(8, 32)
+        batches = [
+            shard_batch(world, next(stream), spec=P(("data", "expert")))
+            for _ in range(4)
+        ]
+        state, _ = step_fn(state, batches[0])
+        state, _ = step_fn(state, batches[1])
+
+        ckpt = CheckpointManager(tmp_path / "ck", world, async_save=False)
+        ckpt.save(2, state)
+
+        cont, m_direct = step_fn(state, batches[2])
+
+        restored = ckpt.restore(init_fn(full), specs_fn(full))
+        assert int(restored.step) == 2
+        resumed, m_resumed = step_fn(restored, batches[2])
+        np.testing.assert_allclose(
+            float(m_direct["loss"]), float(m_resumed["loss"]), rtol=1e-6
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+            ),
+            cont.params,
+            resumed.params,
+        )
+
+    def test_app_ep_tier_trains(self):
+        from mpit_tpu.asyncsgd import gpt2 as app
+
+        out = app.main(
+            ["--mesh", "data=2,expert=4", "--steps", "10", "--batch-size",
+             "8", "--seq-len", "32", "--vocab-size", "128", "--num-layers",
+             "2", "--num-heads", "2", "--d-model", "32", "--moe-experts",
+             "8", "--lr", "0.003", "--log-every", "5"]
+        )
+        assert out["tier"] == "ep-top2-e8"
+        assert out["final_loss"] < out["uniform_loss"]
