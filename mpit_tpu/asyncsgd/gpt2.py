@@ -115,17 +115,50 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
     mesh_shape = cfg.mesh_shape()
     batches = runner.make_stream(cfg, dataset, cfg.seq_len)
 
-    def drive(init_fn, step_fn, make_batch):
-        """Shared loop for the hand-driven tiers (cp / pjit-TP)."""
+    def drive(init_fn, step_fn, make_batch, specs_fn=None):
+        """Shared loop for the hand-driven tiers (ep/pp/cp/3-D/pjit-TP).
+
+        With ``specs_fn`` (a tier's ``state_specs``) and ``--ckpt-dir``,
+        the loop checkpoints/resumes: orbax restore against the tier's
+        own sharding specs, deterministic-stream fast-forward, periodic
+        + final saves (synchronous — the steps donate their input state,
+        so an async save racing the next step's buffer reuse is unsafe).
+        """
         params, _ = init_params()
         state = init_fn(params)
+        ckpt, start = None, 0
+        if cfg.ckpt_dir:
+            if specs_fn is None:
+                raise SystemExit(
+                    "gpt2: --ckpt-dir is not supported on this tier"
+                )
+            from mpit_tpu.train import CheckpointManager
+
+            ckpt = CheckpointManager(cfg.ckpt_dir, world, async_save=False)
+            if ckpt.latest_step() is not None:
+                state = ckpt.restore(state, specs_fn(params))
+                start = int(state.step)
+                # Seek-based resume: rebuild the stream fast-forwarded
+                # (O(1) for the Python datasets; see runner.make_stream).
+                nonlocal batches
+                batches = runner.make_stream(
+                    cfg, dataset, cfg.seq_len, skip=start
+                )
         logger, meter, losses = MetricLogger(), Throughput(), []
-        for step in range(cfg.steps):
+        for step in range(start, cfg.steps):
             state, metrics = step_fn(state, make_batch(next(batches)))
             rate = meter.tick(cfg.batch_size * cfg.seq_len)
             if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
                 losses.append(float(metrics["loss"]))
                 logger.log(step + 1, {"loss": losses[-1], "tokens_per_sec": rate})
+            if (
+                ckpt is not None
+                and cfg.ckpt_every
+                and (step + 1) % cfg.ckpt_every == 0
+            ):
+                ckpt.save(step + 1, state)
+        if ckpt is not None and start < cfg.steps:
+            ckpt.save(cfg.steps, state)
         return state, losses
 
     if cfg.ulysses and not (mesh_shape and "seq" in mesh_shape):
@@ -136,8 +169,6 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
     if mesh_shape and "expert" in mesh_shape:
         # Expert-parallel tier (parallel.ep): routed-MoE MLPs, experts
         # sharded over the expert axis, tokens over data x expert.
-        if cfg.ckpt_dir:
-            raise SystemExit("gpt2: --ckpt-dir is not yet supported on the ep tier")
         if set(mesh_shape) - {"data", "expert"}:
             raise SystemExit(
                 "gpt2: the ep tier composes with a data axis only "
@@ -158,7 +189,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             every=cfg.moe_every,
         )
         moe_model = GPT2MoE(mcfg, moe)
-        init_fn, step_fn, _ = make_gpt2_moe_train_step(
+        init_fn, step_fn, specs_fn = make_gpt2_moe_train_step(
             mcfg, moe, tx, world, aux_weight=cfg.aux_weight, zero1=cfg.zero1
         )
 
@@ -179,12 +210,11 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
                 {"tokens": np.asarray(b["tokens"])[:, : cfg.seq_len + 1]},
                 spec=P_(("data", "expert")),
             ),
+            specs_fn,
         )
         tier = f"ep-top{cfg.moe_k}-e{cfg.moe_experts}"
     elif mesh_shape and "pipe" in mesh_shape and "model" in mesh_shape:
         # 3-D tier (parallel.threed): data x model x pipe.
-        if cfg.ckpt_dir:
-            raise SystemExit("gpt2: --ckpt-dir is not yet supported on the 3-D tier")
         if set(mesh_shape) - {"data", "model", "pipe"}:
             raise SystemExit(
                 "gpt2: the dp-tp-pp tier composes exactly data, model and "
@@ -207,7 +237,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         world = mpit_tpu.init(mesh_shape)
         mcfg_3d = dataclasses.replace(mcfg, tie_head=False)
         m3 = GPT2(mcfg_3d)
-        init_fn, step_fn, _ = make_gpt2_dp_tp_pp_train_step(
+        init_fn, step_fn, specs_fn = make_gpt2_dp_tp_pp_train_step(
             mcfg_3d, tx, world, num_microbatches=cfg.microbatches,
             zero1=cfg.zero1,
         )
@@ -229,13 +259,12 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             lambda b: shard_batch(
                 world, {"tokens": np.asarray(b["tokens"])[:, : cfg.seq_len + 1]}
             ),
+            specs_fn,
         )
         tier = "3d-dp-tp-pp"
     elif mesh_shape and "pipe" in mesh_shape:
         # Pipeline-parallel tier (parallel.pp): blocks split into stages
         # over the pipe axis, GPipe microbatch ring, untied LM head.
-        if cfg.ckpt_dir:
-            raise SystemExit("gpt2: --ckpt-dir is not yet supported on the pp tier")
         if "seq" in mesh_shape:
             raise SystemExit(
                 "gpt2: the pp tier composes only with a data axis "
@@ -250,7 +279,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         n_pipe = world.axis_size("pipe")
         mcfg_pp = dataclasses.replace(mcfg, tie_head=False)
         pp_model = GPT2(mcfg_pp)
-        init_fn, step_fn, _ = make_gpt2_pp_train_step(
+        init_fn, step_fn, specs_fn = make_gpt2_pp_train_step(
             mcfg_pp, tx, world, num_microbatches=cfg.microbatches,
             zero1=cfg.zero1, schedule=cfg.pp_schedule,
         )
@@ -268,13 +297,12 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             lambda b: shard_batch(
                 world, {"tokens": np.asarray(b["tokens"])[:, : cfg.seq_len + 1]}
             ),
+            specs_fn,
         )
         tier = f"pp-{cfg.pp_schedule}-m{cfg.microbatches}"
     elif mesh_shape and "seq" in mesh_shape and "model" in mesh_shape:
         # 3-D tier (parallel.threed): ring attention INSIDE the Megatron
         # block — data x seq x model (TP inside CP).
-        if cfg.ckpt_dir:
-            raise SystemExit("gpt2: --ckpt-dir is not yet supported on the 3-D tier")
         if set(mesh_shape) - {"data", "seq", "model"}:
             raise SystemExit(
                 "gpt2: the dp-cp-tp tier composes exactly data, seq and "
@@ -297,7 +325,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
 
         world = mpit_tpu.init(mesh_shape)
         m7 = GPT2(mcfg)
-        init_fn, step_fn, _ = make_gpt2_dp_cp_tp_train_step(
+        init_fn, step_fn, specs_fn = make_gpt2_dp_cp_tp_train_step(
             mcfg, tx, world, zero1=cfg.zero1
         )
 
@@ -319,15 +347,12 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
                 {"tokens": np.asarray(b["tokens"])[:, : cfg.seq_len]},
                 spec=P_("data", "seq"),
             ),
+            specs_fn,
         )
         tier = "3d-dp-cp-tp"
     elif mesh_shape and "seq" in mesh_shape:
         # Context-parallel tier: sequence sharded over the seq axis, ring
         # attention inside, cross-shard next-token targets (parallel.cp).
-        if cfg.ckpt_dir:
-            raise SystemExit(
-                "gpt2: --ckpt-dir is not yet supported on the cp tier"
-            )
         if "data" not in mesh_shape:
             # Pure CP: a trivial 1-wide data axis keeps the step's specs.
             mesh_shape = {"data": 1, **mesh_shape}
@@ -336,7 +361,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         from mpit_tpu.parallel import make_gpt2_cp_train_step
 
         world = mpit_tpu.init(mesh_shape)
-        init_fn, step_fn, _ = make_gpt2_cp_train_step(
+        init_fn, step_fn, specs_fn = make_gpt2_cp_train_step(
             mcfg, tx, world, zero1=cfg.zero1, flash=cfg.flash,
             ulysses=cfg.ulysses,
         )
@@ -347,6 +372,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
                 {"tokens": np.asarray(b["tokens"])[:, : cfg.seq_len]},
                 spec=P_("data", "seq"),
             ),
+            specs_fn,
         )
         tier = ("cp-ulysses" if cfg.ulysses else "cp-ring") + (
             "-flash" if cfg.flash else ""
@@ -361,6 +387,9 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             init_params,
             tx=tx,
             items_per_batch=cfg.batch_size * cfg.seq_len,
+            stream_factory=lambda skip: runner.make_stream(
+                cfg, dataset, cfg.seq_len, skip=skip
+            ),
         )
         out.update(
             tier="shard_map+zero1",

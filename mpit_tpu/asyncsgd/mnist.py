@@ -73,6 +73,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         init_params,
         eval_fn=eval_fn,
         eval_batch=dataset.eval_batch(cfg.eval_batch),
+        stream_factory=lambda skip: runner.make_stream(cfg, dataset, skip=skip),
     )
 
 

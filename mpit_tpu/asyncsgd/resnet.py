@@ -94,6 +94,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         stateful=True,
         eval_fn=eval_fn,
         eval_batch=dataset.eval_batch(cfg.eval_batch),
+        stream_factory=lambda skip: runner.make_stream(cfg, dataset, skip=skip),
     )
 
 

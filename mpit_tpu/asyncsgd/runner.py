@@ -62,13 +62,21 @@ def classification_dataset(cfg: TrainConfig, synthetic_factory):
     return synthetic_factory()
 
 
-def make_stream(cfg: TrainConfig, dataset, *args):
+def make_stream(cfg: TrainConfig, dataset, *args, skip: int = 0):
     """The workload scripts' input stream: native C++ core when
     ``cfg.native`` (with internal fallback), else the Python generator.
-    Extra ``args`` are forwarded (e.g. ``seq_len`` for LM datasets)."""
+    Extra ``args`` are forwarded (e.g. ``seq_len`` for LM datasets).
+
+    ``skip`` fast-forwards past already-consumed batches on checkpoint
+    resume — O(1)/assembly-free for the Python datasets; the native C++
+    ring has no seek, so its skipped batches are generated (off the GIL)
+    and dropped."""
     if cfg.native:
-        return dataset.native_batches(cfg.batch_size, *args)
-    return dataset.batches(cfg.batch_size, *args)
+        stream = dataset.native_batches(cfg.batch_size, *args)
+        for _ in range(skip):
+            next(stream)
+        return stream
+    return dataset.batches(cfg.batch_size, *args, skip=skip)
 
 
 def build_tx(cfg: TrainConfig, *, axis: str | None = None):
@@ -98,6 +106,7 @@ def run_spmd(
     items_per_batch: int | None = None,
     eval_fn: Callable | None = None,
     eval_batch: dict | None = None,
+    stream_factory: Callable | None = None,
 ) -> dict:
     """Drive the jitted SPMD train step for ``cfg.steps`` steps.
 
@@ -112,6 +121,9 @@ def run_spmd(
         ``cfg.batch_size``; pass tokens-per-batch for LM workloads).
       eval_fn / eval_batch: optional ``(params, extra, batch) -> metrics``
         evaluated at the end on a held-out batch.
+      stream_factory: ``skip -> iterator`` rebuilding the batch stream
+        fast-forwarded past ``skip`` batches (checkpoint resume without
+        materializing the skipped range; see :func:`make_stream`).
     """
     world = mpit_tpu.init(cfg.mesh_shape())
     axis = "data"
@@ -138,17 +150,22 @@ def run_spmd(
     start_step = int(state.step)
     # Resume continues the stream, not restarts it: skip the batches the
     # checkpointed steps already consumed so the resumed trajectory matches
-    # an uninterrupted run (streams here are deterministic generators).
-    for skipped in range(start_step):
-        try:
-            next(batches)
-        except StopIteration:
-            raise RuntimeError(
-                f"checkpoint-resume needs to skip {start_step} consumed "
-                f"batches but the stream ended after {skipped} — the "
-                "stream is shorter than the checkpointed run (did the "
-                "data config change between runs?)"
-            ) from None
+    # an uninterrupted run. With a ``stream_factory`` the skip is seek-based
+    # (O(1) for the Python datasets — no generating-and-discarding);
+    # otherwise fall back to draining the given iterator.
+    if start_step and stream_factory is not None:
+        batches = stream_factory(start_step)
+    else:
+        for skipped in range(start_step):
+            try:
+                next(batches)
+            except StopIteration:
+                raise RuntimeError(
+                    f"checkpoint-resume needs to skip {start_step} consumed "
+                    f"batches but the stream ended after {skipped} — the "
+                    "stream is shorter than the checkpointed run (did the "
+                    "data config change between runs?)"
+                ) from None
     items = items_per_batch or cfg.batch_size
 
     # Per-step ICI traffic model (SURVEY.md §6 metrics row), logged once.
@@ -177,6 +194,26 @@ def run_spmd(
     restores = 0
     restore_before: int | None = None  # ceiling for the next restore target
 
+    # Preemption drain (SURVEY.md §6 recovery row; RECOVERY.md): pod
+    # maintenance/eviction delivers SIGTERM with a grace window. Catch it,
+    # finish the in-flight step, write a final checkpoint, and exit
+    # cleanly so the rescheduled job resumes from it — checkpoint-restart
+    # IS the partial-restart story (JAX SPMD cannot hot-swap pod members;
+    # the restarted world must present the same mesh axis sizes).
+    preempted = {"flag": False}
+
+    def _on_term(signum, frame):
+        del signum, frame
+        preempted["flag"] = True
+
+    prev_handler = None
+    try:
+        import signal
+
+        prev_handler = signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass  # not the main thread (tests, embedded use): no handler
+
     loss_trace: list[tuple[int, float]] = []
     tracing = False
     trace_done = False
@@ -185,6 +222,16 @@ def run_spmd(
         with Prefetcher(world, batches, axis=axis) as stream:
             for batch in stream:
                 if step >= cfg.steps:
+                    break
+                if preempted["flag"]:
+                    if ckpt:
+                        ckpt.save(step, state)
+                        ckpt.wait()
+                    logger.log(
+                        step,
+                        {"event": "preempted_checkpoint_and_exit",
+                         "resumable": bool(ckpt)},
+                    )
                     break
                 if (
                     prof_window
@@ -248,6 +295,10 @@ def run_spmd(
     finally:
         if tracing:  # run ended (or raised) inside the window
             jax.profiler.stop_trace()
+        if prev_handler is not None:
+            import signal
+
+            signal.signal(signal.SIGTERM, prev_handler)
     if ckpt:
         ckpt.wait()
 
@@ -259,6 +310,7 @@ def run_spmd(
         "losses": losses,
         "final_loss": losses[-1] if losses else float("nan"),
         "restores": restores,
+        "preempted": preempted["flag"],
     }
     if eval_fn is not None and eval_batch is not None:
         ev = make_eval_step(eval_fn, world, axis=axis)

@@ -123,20 +123,26 @@ class FileClassification:
         return out.astype(np.float32, copy=False)
 
     def batches(
-        self, batch_size: int, *, seed: int | None = None
+        self, batch_size: int, *, seed: int | None = None, skip: int = 0
     ) -> Iterator[dict[str, np.ndarray]]:
         """Infinite stream of ``{"image": [B,...] f32, "label": [B] i32}``:
         a fresh seeded shuffle every epoch, last partial batch dropped
-        (static shapes — XLA recompiles on shape change)."""
+        (static shapes — XLA recompiles on shape change). ``skip=N``
+        fast-forwards to batch N drawing only the epoch permutations —
+        no batch assembly/IO for the skipped range (checkpoint resume)."""
         n = len(self)
         if batch_size > n:
             raise ValueError(
                 f"batch_size {batch_size} exceeds dataset size {n}"
             )
         rng = np.random.RandomState(self.seed + 1 if seed is None else seed)
+        produced = 0
         while True:
             order = rng.permutation(n)
             for lo in range(0, n - batch_size + 1, batch_size):
+                if produced < skip:
+                    produced += 1
+                    continue
                 idx = np.sort(order[lo : lo + batch_size])  # mmap-friendly
                 yield {
                     "image": self._assemble(self._images[idx]),
@@ -211,9 +217,16 @@ class FileLM:
         return out
 
     def batches(
-        self, batch_size: int, seq_len: int, *, seed: int | None = None
+        self, batch_size: int, seq_len: int, *, seed: int | None = None,
+        skip: int = 0,
     ) -> Iterator[dict[str, np.ndarray]]:
+        """``skip=N`` fast-forwards by drawing (and discarding) only the
+        skipped batches' start offsets — no window assembly."""
         rng = np.random.RandomState(self.seed + 1 if seed is None else seed)
+        n = len(self._tokens)
+        if n >= seq_len + 1:
+            for _ in range(skip):
+                rng.randint(0, n - seq_len, size=batch_size)
         while True:
             yield {"tokens": self._windows(self._tokens, batch_size, seq_len, rng)}
 

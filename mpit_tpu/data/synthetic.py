@@ -38,11 +38,20 @@ class SyntheticClassification:
         )
 
     def batches(
-        self, batch_size: int, *, seed: int | None = None
+        self, batch_size: int, *, seed: int | None = None, skip: int = 0
     ) -> Iterator[dict[str, np.ndarray]]:
-        """Infinite stream of ``{"image": [B,...], "label": [B]}``."""
-        rng = np.random.RandomState(self.seed + 1 if seed is None else seed)
+        """Infinite stream of ``{"image": [B,...], "label": [B]}``.
+
+        Counter-based RNG (a fresh ``RandomState`` per batch index), so
+        ``skip=N`` resumes the exact stream at batch N in O(1) — no
+        generating-and-discarding N batches on checkpoint resume
+        (RECOVERY.md; round-2 review finding).
+        """
+        base = self.seed + 1 if seed is None else seed
+        idx = skip
         while True:
+            rng = np.random.RandomState((base * 1_000_003 + idx) % 2**31)
+            idx += 1
             labels = rng.randint(0, self.num_classes, size=(batch_size,))
             images = self.prototypes[labels] + self.noise * rng.randn(
                 batch_size, *self.image_shape
@@ -119,11 +128,17 @@ class SyntheticLM:
         return float(np.log(self.branching))
 
     def batches(
-        self, batch_size: int, seq_len: int, *, seed: int | None = None
+        self, batch_size: int, seq_len: int, *, seed: int | None = None,
+        skip: int = 0,
     ) -> Iterator[dict[str, np.ndarray]]:
-        """Infinite stream of ``{"tokens": [B, L+1]}`` (shift for targets)."""
-        rng = np.random.RandomState(self.seed + 1 if seed is None else seed)
+        """Infinite stream of ``{"tokens": [B, L+1]}`` (shift for targets).
+        Counter-based per-batch RNG: ``skip=N`` is O(1) (see
+        ``SyntheticClassification.batches``)."""
+        base = self.seed + 1 if seed is None else seed
+        idx = skip
         while True:
+            rng = np.random.RandomState((base * 1_000_003 + idx) % 2**31)
+            idx += 1
             toks = np.empty((batch_size, seq_len + 1), np.int32)
             toks[:, 0] = rng.randint(0, self.vocab_size, size=batch_size)
             for t in range(seq_len):

@@ -125,12 +125,16 @@ class GPT2MoE(nn.Module):
         pe = wpe[:t] if positions is None else wpe[positions]
         x = wte[tokens].astype(cfg.dtype) + pe.astype(cfg.dtype)
         aux = jnp.zeros((), jnp.float32)
+        moe_block, dense_block = MoEBlock, Block
+        if cfg.remat:
+            moe_block = nn.remat(MoEBlock)
+            dense_block = nn.remat(Block)
         for i in range(cfg.num_layers):
             if (i + 1) % moe.every == 0:
-                x, a = MoEBlock(cfg, moe, name=f"block_{i}")(x)
+                x, a = moe_block(cfg, moe, name=f"block_{i}")(x)
                 aux = aux + a
             else:
-                x = Block(cfg, name=f"block_{i}")(x)
+                x = dense_block(cfg, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         head = (
             wte

@@ -107,6 +107,27 @@ def sharded(
     return optax.GradientTransformation(init, update)
 
 
+def grouped_state_specs(
+    tx: optax.GradientTransformation,
+    params,
+    n: int,
+    data_axis: str,
+    axes,
+):
+    """:func:`state_partition_specs` for one *placement group* of a
+    multi-axis tier: the flat per-shard vectors live per coordinate of
+    ``axes`` (e.g. ``('pipe', 'model', 'data')``), so the vector-leaf spec
+    is ``P(axes)`` instead of ``P(data_axis)``. Shared by the per-group
+    ZeRO-1 tiers (``parallel.pp`` / ``parallel.threed`` / ``parallel.ep``)
+    — one place to fix the remapping."""
+    from jax.sharding import PartitionSpec as _P
+
+    specs = state_partition_specs(tx, params, n, data_axis)
+    return jax.tree.map(
+        lambda s: _P(tuple(axes)) if s == _P(data_axis) else s, specs
+    )
+
+
 def state_partition_specs(
     tx: optax.GradientTransformation, params, n: int, axis: str
 ):

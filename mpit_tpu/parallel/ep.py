@@ -45,7 +45,7 @@ from mpit_tpu.models.gpt2 import GPT2Config
 # NOTE: models.gpt2_moe imports parallel.moe, so importing it at module
 # scope from inside the parallel package would be circular — the model
 # symbols are imported lazily in make_gpt2_moe_train_step.
-from mpit_tpu.opt.sharded import state_partition_specs
+from mpit_tpu.opt.sharded import grouped_state_specs
 from mpit_tpu.train.step import TrainState
 
 import dataclasses
@@ -129,15 +129,13 @@ def make_gpt2_moe_train_step(
 
             return jax.tree_util.tree_map_with_path(spec_for, shapes)
 
-        def flat_specs(tree, axes):
-            specs = state_partition_specs(tx, tree, n_data, data_axis)
-            return jax.tree.map(
-                lambda s: P(axes) if s == P(data_axis) else s, specs
-            )
-
         return {
-            "expert": flat_specs(g_exp, (expert_axis, data_axis)),
-            "rest": flat_specs(g_rest, (data_axis,)),
+            "expert": grouped_state_specs(
+                tx, g_exp, n_data, data_axis, (expert_axis, data_axis)
+            ),
+            "rest": grouped_state_specs(
+                tx, g_rest, n_data, data_axis, (data_axis,)
+            ),
         }
 
     def state_specs(params, extra=()):

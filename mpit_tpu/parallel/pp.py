@@ -57,7 +57,7 @@ from mpit_tpu import opt as gopt
 from mpit_tpu.comm import collectives as C
 from mpit_tpu.models.gpt2 import Block, GPT2Config
 from mpit_tpu.ops.lm_head import lm_head_xent
-from mpit_tpu.opt.sharded import state_partition_specs
+from mpit_tpu.opt.sharded import grouped_state_specs
 from mpit_tpu.parallel.pipeline import (
     spmd_pipeline,
     spmd_pipeline_1f1b,
@@ -167,14 +167,15 @@ def make_gpt2_pp_train_step(
             # Flat sharded-state specs per group: stage-state shards live
             # per (pipe, data) coordinate; rest-state shards per data
             # coordinate, replicated over pipe.
-            stage_specs = jax.tree.map(
-                lambda s: P((pipe_axis, data_axis)) if s == P(data_axis) else s,
-                state_partition_specs(tx, local["stages"], n_data, data_axis),
-            )
-            rest_specs = state_partition_specs(
-                tx, local["rest"], n_data, data_axis
-            )
-            return {"stages": stage_specs, "rest": rest_specs}
+            return {
+                "stages": grouped_state_specs(
+                    tx, local["stages"], n_data, data_axis,
+                    (pipe_axis, data_axis),
+                ),
+                "rest": grouped_state_specs(
+                    tx, local["rest"], n_data, data_axis, (data_axis,)
+                ),
+            }
         shapes = jax.eval_shape(tx.init, local)
 
         def spec_for(path, leaf):

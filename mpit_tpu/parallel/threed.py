@@ -48,7 +48,7 @@ from mpit_tpu import opt as gopt
 from mpit_tpu.comm import collectives as C
 from mpit_tpu.models.gpt2 import GPT2Config
 from mpit_tpu.ops.lm_head import lm_head_xent
-from mpit_tpu.opt.sharded import state_partition_specs
+from mpit_tpu.opt.sharded import grouped_state_specs
 from mpit_tpu.parallel.megatron import (
     layernorm,
     repack_qkv,
@@ -178,15 +178,20 @@ def make_gpt2_dp_tp_pp_train_step(
             f"num_heads ({cfg.num_heads}) must divide by model={n_model}"
         )
 
+    apply_block = partial(
+        tp_transformer_block,
+        num_heads=cfg.num_heads,
+        axis=model_axis,
+        dtype=cfg.dtype,
+    )
+    if cfg.remat:
+        # Honor activation checkpointing inside the pipeline scan — at
+        # the scales that need 3-D parallelism this is load-bearing.
+        apply_block = jax.checkpoint(apply_block)
+
     def stage_fn(stage_params, x):
         def body(h, p):
-            return (
-                tp_transformer_block(
-                    p, h, num_heads=cfg.num_heads, axis=model_axis,
-                    dtype=cfg.dtype,
-                ),
-                None,
-            )
+            return apply_block(p, h), None
 
         y, _ = lax.scan(body, x, stage_params)
         return y
@@ -233,22 +238,19 @@ def make_gpt2_dp_tp_pp_train_step(
             return jax.tree_util.tree_map_with_path(spec_for, shapes)
         local = jax.eval_shape(_local_view, split_params)
         g_sh, g_rep, rest = _groups(local)
-
-        def flat_specs(tree, axes):
-            # None holes are empty pytree nodes: ravel/init skip them.
-            specs = state_partition_specs(
-                tx, tree, world.axis_size(data_axis), data_axis
-            )
-            return jax.tree.map(
-                lambda s: P(axes) if s == P(data_axis) else s, specs
-            )
-
+        n_d = world.axis_size(data_axis)
+        # None holes are empty pytree nodes: ravel/init skip them.
         return {
-            "tp_sharded": flat_specs(
-                g_sh, (pipe_axis, model_axis, data_axis)
+            "tp_sharded": grouped_state_specs(
+                tx, g_sh, n_d, data_axis,
+                (pipe_axis, model_axis, data_axis),
             ),
-            "tp_replicated": flat_specs(g_rep, (pipe_axis, data_axis)),
-            "rest": flat_specs(rest, (data_axis,)),
+            "tp_replicated": grouped_state_specs(
+                tx, g_rep, n_d, data_axis, (pipe_axis, data_axis)
+            ),
+            "rest": grouped_state_specs(
+                tx, rest, n_d, data_axis, (data_axis,)
+            ),
         }
 
     def state_specs(split_params, extra=()):
@@ -453,6 +455,15 @@ def make_gpt2_dp_cp_tp_train_step(
         )
 
     attention_fn = partial(ring_attention, axis=seq_axis)
+    apply_block = partial(
+        tp_transformer_block,
+        num_heads=cfg.num_heads,
+        axis=model_axis,
+        attention_fn=attention_fn,
+        dtype=cfg.dtype,
+    )
+    if cfg.remat:
+        apply_block = jax.checkpoint(apply_block)
 
     def _specs(params):
         return {
@@ -478,19 +489,17 @@ def make_gpt2_dp_cp_tp_train_step(
 
             return jax.tree_util.tree_map_with_path(spec_for, shapes)
         g_sh, g_rep = _partition_block_tree(params["blocks"])
-
-        def flat_specs(tree, axes):
-            specs = state_partition_specs(
-                tx, tree, world.axis_size(data_axis), data_axis
-            )
-            return jax.tree.map(
-                lambda s: P(axes) if s == P(data_axis) else s, specs
-            )
-
+        n_d = world.axis_size(data_axis)
         return {
-            "tp_sharded": flat_specs(g_sh, (model_axis, data_axis)),
-            "tp_replicated": flat_specs(g_rep, (data_axis,)),
-            "rest": flat_specs(params["rest"], (data_axis,)),
+            "tp_sharded": grouped_state_specs(
+                tx, g_sh, n_d, data_axis, (model_axis, data_axis)
+            ),
+            "tp_replicated": grouped_state_specs(
+                tx, g_rep, n_d, data_axis, (data_axis,)
+            ),
+            "rest": grouped_state_specs(
+                tx, params["rest"], n_d, data_axis, (data_axis,)
+            ),
         }
 
     def state_specs(params, extra=()):
@@ -567,13 +576,7 @@ def make_gpt2_dp_cp_tp_train_step(
             ].astype(cfg.dtype)
 
             def body(h, p):
-                return (
-                    tp_transformer_block(
-                        p, h, num_heads=cfg.num_heads, axis=model_axis,
-                        attention_fn=attention_fn, dtype=cfg.dtype,
-                    ),
-                    None,
-                )
+                return apply_block(p, h), None
 
             h, _ = lax.scan(body, x, blocks)
             head = rest["wte"] if cfg.tie_head else rest["head"]

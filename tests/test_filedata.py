@@ -182,3 +182,50 @@ class TestMetaMerge:
         write_lm(lm, np.arange(64) % 50)  # infers 50
         write_lm(lm, np.zeros(64, np.int32), split="val")  # max token 0
         assert FileLM(lm).vocab_size == 50
+
+
+class TestStreamSkip:
+    """Seek-based resume (round-2 review finding): batches(skip=N) must
+    equal draining N batches — without assembling the skipped range."""
+
+    def test_file_lm_skip_matches_drain(self, tmp_path):
+        from mpit_tpu.data import FileLM, write_lm
+
+        d = write_lm(str(tmp_path / "lm"), np.arange(4096) % 97, vocab_size=97)
+        drained = FileLM(d).batches(4, 16)
+        for _ in range(7):
+            next(drained)
+        want = next(drained)
+        got = next(FileLM(d).batches(4, 16, skip=7))
+        np.testing.assert_array_equal(got["tokens"], want["tokens"])
+
+    def test_file_classification_skip_matches_drain(self, tmp_path):
+        d, _, _ = _cls_fixture(tmp_path)
+        from mpit_tpu.data import FileClassification
+
+        drained = FileClassification(d).batches(16)
+        for _ in range(5):  # crosses an epoch boundary (4 batches/epoch)
+            next(drained)
+        want = next(drained)
+        got = next(FileClassification(d).batches(16, skip=5))
+        np.testing.assert_array_equal(got["label"], want["label"])
+        np.testing.assert_allclose(got["image"], want["image"])
+
+    def test_synthetic_skip_matches_drain(self):
+        from mpit_tpu.data import SyntheticLM, synthetic_mnist
+
+        ds = synthetic_mnist(seed=3)
+        drained = ds.batches(8)
+        for _ in range(3):
+            next(drained)
+        want = next(drained)
+        got = next(ds.batches(8, skip=3))
+        np.testing.assert_allclose(got["image"], want["image"])
+
+        lm = SyntheticLM(vocab_size=64, seed=1)
+        drained = lm.batches(4, 16)
+        for _ in range(3):
+            next(drained)
+        want = next(drained)
+        got = next(lm.batches(4, 16, skip=3))
+        np.testing.assert_array_equal(got["tokens"], want["tokens"])

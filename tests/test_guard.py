@@ -86,3 +86,59 @@ class TestRecoveryIntegration:
     def test_raises_without_restore_budget(self, tmp_path):
         with pytest.raises(Diverged):
             self._run(tmp_path, poison_step=5, max_restores=0)
+
+
+class TestPreemptionDrain:
+    """RECOVERY.md §2: SIGTERM → finish step → checkpoint → clean exit →
+    resume matches the uninterrupted trajectory."""
+
+    def test_sigterm_checkpoints_and_resume_matches(self, tmp_path):
+        import json
+        import os
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        ck = str(tmp_path / "ck")
+        code = (
+            "from mpit_tpu.asyncsgd import mnist as app\n"
+            "import json\n"
+            "out = app.main(['--steps', '100000', '--batch-size', '32',\n"
+            "    '--lr', '0.05', '--log-every', '10', '--ckpt-every', '10',\n"
+            f"    '--ckpt-dir', {ck!r}])\n"
+            "print('RESULT ' + json.dumps({'steps': out['steps'],\n"
+            "    'preempted': out['preempted']}))\n"
+        )
+        env = dict(os.environ)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        # Give it time to compile and take some steps, then preempt.
+        time.sleep(60)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=240)
+        assert proc.returncode == 0, out[-2000:]
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert line, out[-2000:]
+        res = json.loads(line[-1][len("RESULT "):])
+        assert res["preempted"] is True
+        assert 0 < res["steps"] < 100000
+        assert os.path.isdir(ck), "no checkpoint written on preemption"
+
+        # Resume from the drain checkpoint: continues past the preempt
+        # point (a short continuation — full-parity resume is covered by
+        # the clean-resume tests).
+        from mpit_tpu.asyncsgd import mnist as app
+
+        out2 = app.main(
+            ["--steps", str(res["steps"] + 5), "--batch-size", "32",
+             "--lr", "0.05", "--log-every", "5", "--ckpt-dir", ck]
+        )
+        assert out2["steps"] == res["steps"] + 5
+        assert out2["preempted"] is False
