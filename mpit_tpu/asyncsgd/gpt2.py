@@ -398,14 +398,11 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         )
         return out
     else:
-        # GSPMD/pjit tier: TP (+ optional FSDP) via sharding rules.
-        if cfg.ckpt_dir:
-            raise SystemExit(
-                "gpt2: --ckpt-dir is not yet supported on the pjit TP tier "
-                "(use the shard_map tier, i.e. a mesh without a model axis)"
-            )
+        # GSPMD/pjit tier: TP (+ optional FSDP) via sharding rules. The
+        # shardings_fn doubles as the checkpoint layout (NamedShardings —
+        # CheckpointManager.restore accepts them directly).
         world = mpit_tpu.init(mesh_shape)
-        init_fn, step_fn, _ = make_pjit_train_step(
+        init_fn, step_fn, shardings_fn = make_pjit_train_step(
             loss_fn,
             tx,
             world,
@@ -413,7 +410,8 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             fsdp_axis=cfg.fsdp_axis or None,
         )
         state, losses = drive(
-            init_fn, step_fn, lambda b: jax.tree.map(np.asarray, b)
+            init_fn, step_fn, lambda b: jax.tree.map(np.asarray, b),
+            shardings_fn,
         )
         tier = "pjit-tp" + ("+fsdp" if cfg.fsdp_axis else "")
 

@@ -50,15 +50,21 @@ class CheckpointManager:
         """Restore the checkpoint at ``step`` (default: latest).
 
         ``state_like`` supplies shapes/dtypes (concrete or abstract arrays);
-        ``specs`` the PartitionSpecs to lay shards out with.
+        ``specs`` the layout — PartitionSpecs (the shard_map tiers'
+        ``state_specs``) or ready-made ``NamedSharding``s (the pjit tier's
+        ``shardings_fn``).
         """
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError("no checkpoint found")
         mesh = self._world.mesh
+
+        def as_sharding(s):
+            return s if isinstance(s, NamedSharding) else NamedSharding(mesh, s)
+
         abstract = jax.tree.map(
             lambda x, s: jax.ShapeDtypeStruct(
-                x.shape, x.dtype, sharding=NamedSharding(mesh, s)
+                x.shape, x.dtype, sharding=as_sharding(s)
             ),
             state_like,
             specs,

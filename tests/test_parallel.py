@@ -1352,10 +1352,14 @@ class TestTierCheckpointing:
     """--ckpt-dir on the hand-driven tiers (round 2): restore against the
     tier's own state_specs + deterministic stream fast-forward."""
 
-    def test_pp_tier_resume_matches_uninterrupted(self, tmp_path):
+    @pytest.mark.parametrize(
+        "mesh", ["data=2,pipe=4", "data=4,model=2", "data=2,expert=4",
+                 "data=2,seq=4"]
+    )
+    def test_tier_resume_matches_uninterrupted(self, tmp_path, mesh):
         from mpit_tpu.asyncsgd import gpt2 as app
 
-        args = ["--mesh", "data=2,pipe=4", "--batch-size", "8",
+        args = ["--mesh", mesh, "--batch-size", "8",
                 "--seq-len", "32", "--vocab-size", "128", "--num-layers",
                 "4", "--num-heads", "2", "--d-model", "32", "--log-every",
                 "3"]
