@@ -46,6 +46,10 @@ class TrainConfig:
     lr_end_scale: float = 0.0  # warmup_cosine: final lr as a fraction of lr
     decay_every: int = 0  # step schedule: decay period
     decay_factor: float = 0.1  # step schedule: decay multiplier
+    # Decay horizon for warmup_cosine (0 = this run's --steps). Pin it
+    # explicitly when resuming with a different --steps, or the restored
+    # GooState.count lands on a reshaped LR curve (RECOVERY.md).
+    schedule_horizon: int = 0
     zero1: bool = True  # shard goo state across the data axis (SPMD mode)
     easgd: bool = False  # elastic-averaging dynamics instead of Downpour
     easgd_alpha: float = 0.125

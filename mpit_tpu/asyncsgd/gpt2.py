@@ -135,6 +135,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             from mpit_tpu.train import CheckpointManager
 
             ckpt = CheckpointManager(cfg.ckpt_dir, world, async_save=False)
+            ckpt.ensure_meta(runner.run_meta(cfg))
             if ckpt.latest_step() is not None:
                 state = ckpt.restore(state, specs_fn(params))
                 start = int(state.step)
@@ -165,6 +166,14 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         raise SystemExit(
             "gpt2: --ulysses true requires the cp tier (a mesh with a seq "
             "axis, e.g. --mesh data=4,seq=2)"
+        )
+    if not cfg.fused_loss and mesh_shape and (
+        {"pipe", "seq", "expert"} & set(mesh_shape)
+    ):
+        raise SystemExit(
+            "gpt2: --fused-loss false is only honored on the DP and "
+            "pjit-TP tiers; the cp/pp/3-D/ep tiers hardcode the fused "
+            "streaming LM-head xent (ops/lm_head.py)"
         )
     if mesh_shape and "expert" in mesh_shape:
         # Expert-parallel tier (parallel.ep): routed-MoE MLPs, experts

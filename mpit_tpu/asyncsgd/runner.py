@@ -68,15 +68,57 @@ def make_stream(cfg: TrainConfig, dataset, *args, skip: int = 0):
     Extra ``args`` are forwarded (e.g. ``seq_len`` for LM datasets).
 
     ``skip`` fast-forwards past already-consumed batches on checkpoint
-    resume — O(1)/assembly-free for the Python datasets; the native C++
-    ring has no seek, so its skipped batches are generated (off the GIL)
-    and dropped."""
+    resume — O(1)/assembly-free for the Python datasets (including the
+    file-backed ones under ``--native``, whose "native" alias is mmap'd
+    numpy); only the true C++ ring drains, inside ``native_batches``."""
     if cfg.native:
-        stream = dataset.native_batches(cfg.batch_size, *args)
-        for _ in range(skip):
-            next(stream)
-        return stream
+        return dataset.native_batches(cfg.batch_size, *args, skip=skip)
     return dataset.batches(cfg.batch_size, *args, skip=skip)
+
+
+def run_meta(cfg: TrainConfig) -> dict:
+    """The fields pinned to a checkpoint directory
+    (``CheckpointManager.ensure_meta``): everything the
+    resumed-trajectory-equals-uninterrupted-run guarantee depends on —
+    the LR-curve geometry, the optimizer dynamics, and the data-order
+    determinants (batch size, seed, data source, and which stream
+    implementation draws the RNG). ``data_dir`` is resolved to an
+    absolute path so the same dataset reached via different spellings
+    (or a different cwd) compares correctly. ``stream_impl`` records the
+    *resolved* stream — the C++ core's RNG stream differs from the
+    Python fallback's, so resuming a native-core run on a host where the
+    core is unavailable must be rejected, not silently fall back
+    (``--native`` with a file dataset or an unbuilt core runs the Python
+    path on both sides, so only the synthetic native core pins).
+    Workload-specific config fields (everything a ``TrainConfig``
+    subclass adds: model hyperparameters, loss/numerics flags) are
+    pinned wholesale — shape-preserving drift like gpt2 ``num_heads`` or
+    ``moe_k`` restores cleanly through orbax and would otherwise
+    silently change the function being resumed."""
+    import os
+
+    from mpit_tpu.data import native as native_mod
+
+    uses_native_core = (
+        cfg.native and not cfg.data_dir and native_mod.available()
+    )
+    meta = {
+        **gopt.schedules.geometry(cfg),
+        "momentum": cfg.momentum,
+        "weight_decay": cfg.weight_decay,
+        "batch_size": cfg.batch_size,
+        "seed": cfg.seed,
+        "data_dir": os.path.abspath(cfg.data_dir) if cfg.data_dir else "",
+        "stream_impl": "native_core" if uses_native_core else "python",
+        "easgd": cfg.easgd,
+    }
+    if cfg.easgd:
+        meta["easgd_alpha"] = cfg.easgd_alpha
+    base_fields = {f.name for f in dataclasses.fields(TrainConfig)}
+    for f in dataclasses.fields(type(cfg)):
+        if f.name not in base_fields:
+            meta[f.name] = getattr(cfg, f.name)
+    return meta
 
 
 def build_tx(cfg: TrainConfig, *, axis: str | None = None):
@@ -142,6 +184,7 @@ def run_spmd(
     ckpt = None
     if cfg.ckpt_dir:
         ckpt = CheckpointManager(cfg.ckpt_dir, world)
+        ckpt.ensure_meta(run_meta(cfg))
         if ckpt.latest_step() is not None:
             state = ckpt.restore(state, state_specs(params, extra))
 
@@ -207,10 +250,12 @@ def run_spmd(
         preempted["flag"] = True
 
     prev_handler = None
+    handler_installed = False
     try:
         import signal
 
         prev_handler = signal.signal(signal.SIGTERM, _on_term)
+        handler_installed = True
     except ValueError:
         pass  # not the main thread (tests, embedded use): no handler
 
@@ -295,10 +340,16 @@ def run_spmd(
     finally:
         if tracing:  # run ended (or raised) inside the window
             jax.profiler.stop_trace()
-        if prev_handler is not None:
+        if handler_installed:
+            # Restore unconditionally (getsignal-None priors included —
+            # prev_handler None means "installed outside Python", and
+            # SIG_DFL is the closest restorable equivalent).
             import signal
 
-            signal.signal(signal.SIGTERM, prev_handler)
+            signal.signal(
+                signal.SIGTERM,
+                prev_handler if prev_handler is not None else signal.SIG_DFL,
+            )
     if ckpt:
         ckpt.wait()
 

@@ -166,7 +166,11 @@ class FileClassification:
         }
 
     def native_batches(self, batch_size: int, **kw):
-        return self.batches(batch_size, seed=kw.get("seed"))
+        # Pure-Python alias (file IO is mmap'd numpy; no separate C++
+        # path) — forward skip so seek-based resume works under --native.
+        return self.batches(
+            batch_size, seed=kw.get("seed"), skip=kw.get("skip", 0)
+        )
 
 
 @dataclasses.dataclass
@@ -238,7 +242,9 @@ class FileLM:
         return {"tokens": self._windows(tokens, batch_size, seq_len, rng)}
 
     def native_batches(self, batch_size: int, seq_len: int, **kw):
-        return self.batches(batch_size, seq_len, seed=kw.get("seed"))
+        return self.batches(
+            batch_size, seq_len, seed=kw.get("seed"), skip=kw.get("skip", 0)
+        )
 
 
 def write_classification(

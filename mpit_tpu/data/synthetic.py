@@ -62,22 +62,33 @@ class SyntheticClassification:
         return next(self.batches(batch_size, seed=seed))
 
     def native_batches(
-        self, batch_size: int, *, seed: int | None = None, threads: int = 2
+        self,
+        batch_size: int,
+        *,
+        seed: int | None = None,
+        threads: int = 2,
+        skip: int = 0,
     ):
         """The same stream produced by the C++ core (zero-copy slot views);
         falls back to :meth:`batches` when the native build is unavailable.
-        Same distribution/learnable structure, different RNG stream."""
+        Same distribution/learnable structure, different RNG stream.
+        ``skip`` fast-forwards on resume: O(1) on the Python fallback; the
+        C++ ring has no seek, so its skipped batches are generated (off
+        the GIL) and dropped."""
         from mpit_tpu.data import native
 
         if not native.available():
-            return self.batches(batch_size, seed=seed)
-        return native.classification_stream(
+            return self.batches(batch_size, seed=seed, skip=skip)
+        stream = native.classification_stream(
             self.prototypes,
             noise=self.noise,
             batch_size=batch_size,
             seed=self.seed + 1 if seed is None else seed,
             threads=threads,
         )
+        for _ in range(skip):
+            next(stream)
+        return stream
 
 
 def synthetic_mnist(noise: float = 0.4, seed: int = 0) -> SyntheticClassification:
@@ -153,17 +164,22 @@ class SyntheticLM:
         *,
         seed: int | None = None,
         threads: int = 2,
+        skip: int = 0,
     ):
         """C++-core token stream; falls back to :meth:`batches` when the
-        native build is unavailable."""
+        native build is unavailable. ``skip``: as in
+        ``SyntheticClassification.native_batches``."""
         from mpit_tpu.data import native
 
         if not native.available():
-            return self.batches(batch_size, seq_len, seed=seed)
-        return native.lm_stream(
+            return self.batches(batch_size, seq_len, seed=seed, skip=skip)
+        stream = native.lm_stream(
             self.successors,
             seq_len=seq_len,
             batch_size=batch_size,
             seed=self.seed + 1 if seed is None else seed,
             threads=threads,
         )
+        for _ in range(skip):
+            next(stream)
+        return stream

@@ -58,6 +58,15 @@ def step_decay(
     return schedule
 
 
+def _horizon(cfg) -> int:
+    """Decay horizon: an explicit --schedule-horizon survives checkpoint
+    resume with a different --steps (the restored count must land on the
+    same curve as the original run — RECOVERY.md); default is the run's
+    step budget. Single source of truth for both :func:`from_config`
+    (the curve) and :func:`geometry` (the pin that guards it)."""
+    return getattr(cfg, "schedule_horizon", 0) or cfg.steps
+
+
 def from_config(cfg, total_steps: int | None = None) -> LearningRate:
     """Build the lr (constant or schedule) from a ``TrainConfig``.
 
@@ -65,7 +74,7 @@ def from_config(cfg, total_steps: int | None = None) -> LearningRate:
     ``"warmup"``, ``"warmup_cosine"``, ``"step"``.
     """
     name = getattr(cfg, "schedule", "") or ""
-    total = total_steps if total_steps is not None else cfg.steps
+    total = total_steps if total_steps is not None else _horizon(cfg)
     if name == "":
         return cfg.lr
     if name == "warmup":
@@ -90,3 +99,21 @@ def from_config(cfg, total_steps: int | None = None) -> LearningRate:
         f"unknown schedule {name!r} (expected '', 'warmup', "
         "'warmup_cosine', or 'step')"
     )
+
+
+def geometry(cfg) -> dict:
+    """The schedule fields that must match across runs sharing a
+    checkpoint directory (validated by ``CheckpointManager.ensure_meta``):
+    the resolved decay horizon plus everything that shapes the lr curve."""
+    name = getattr(cfg, "schedule", "") or ""
+    geo = {"schedule": name, "lr": cfg.lr}
+    if name == "":
+        return geo
+    geo["warmup_steps"] = cfg.warmup_steps
+    if name == "warmup_cosine":
+        geo["horizon"] = _horizon(cfg)
+        geo["lr_end_scale"] = cfg.lr_end_scale
+    elif name == "step":
+        geo["decay_every"] = cfg.decay_every
+        geo["decay_factor"] = cfg.decay_factor
+    return geo

@@ -11,6 +11,8 @@ the trainer's PartitionSpecs.
 
 from __future__ import annotations
 
+import json
+import os
 from pathlib import Path
 from typing import Any
 
@@ -36,12 +38,76 @@ class CheckpointManager:
         async_save: bool = True,
     ):
         self._world = world
+        self._dir = Path(directory).absolute()
         self._mgr = ocp.CheckpointManager(
-            Path(directory).absolute(),
+            self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, enable_async_checkpointing=async_save
             ),
         )
+
+    def ensure_meta(self, meta: dict) -> None:
+        """Pin run geometry to the checkpoint directory.
+
+        While the directory holds a restorable checkpoint, every run
+        against it must present the same ``meta`` values — a resume with,
+        say, a different ``warmup_cosine`` horizon silently reshapes the
+        LR curve under the restored ``GooState.count``, and a different
+        batch size / seed / data source silently diverges the
+        fast-forwarded data order; geometry drift is an error, not a
+        footnote (RECOVERY.md). With nothing to resume (fresh directory,
+        or a run that died before its first save) the guarantee is
+        vacuous, so the meta is (re)written instead of validated. Only
+        process 0 writes (orbax convention); every process validates.
+        """
+        path = self._dir / "run_meta.json"
+        if path.exists() and self.latest_step() is not None:
+            try:
+                with open(path) as f:
+                    recorded = json.load(f)
+            except (json.JSONDecodeError, OSError) as e:
+                raise ValueError(
+                    f"{path} is unreadable ({e}); the checkpoint directory "
+                    "has a checkpoint but corrupt run metadata — delete "
+                    "run_meta.json to re-pin it from this run's flags"
+                ) from None
+            drift = {
+                k: (recorded.get(k), v)
+                for k, v in meta.items()
+                if k in recorded and recorded[k] != v
+            }
+            if drift:
+                lines = ", ".join(
+                    f"{k}: checkpoint has {a!r}, this run has {b!r}"
+                    for k, (a, b) in drift.items()
+                )
+                raise ValueError(
+                    f"checkpoint directory {self._dir} was written by a run "
+                    f"with different geometry ({lines}); pass matching flags "
+                    "(e.g. --schedule-horizon pins the decay length across "
+                    "runs with different --steps) or use a fresh --ckpt-dir"
+                )
+            return
+        if not path.exists() and self.latest_step() is not None:
+            # Pre-upgrade directory (checkpoint written before run-meta
+            # pinning existed, or the user deleted a corrupt meta): the
+            # original geometry is unknowable, so pin this run's flags —
+            # but say so, since drift against the ORIGINAL run cannot be
+            # detected.
+            import warnings
+
+            warnings.warn(
+                f"{self._dir} holds a checkpoint but no run_meta.json; "
+                "pinning this run's flags as the geometry — drift against "
+                "the run that wrote the checkpoint cannot be validated",
+                stacklevel=2,
+            )
+        if jax.process_index() == 0:
+            self._dir.mkdir(parents=True, exist_ok=True)
+            tmp = path.with_suffix(".json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(meta, f, indent=1)
+            os.replace(tmp, path)  # atomic: no partial file is ever visible
 
     def save(self, step: int, state: Any) -> None:
         self._mgr.save(step, args=ocp.args.StandardSave(state))

@@ -222,3 +222,42 @@ class TestCheckpoint:
             shard_batch(world8, next(stream)),
         )
         assert int(state2.step) == 4
+
+    def test_run_meta_pins_schedule_geometry(self, world8, tmp_path):
+        """A resume with a different decay horizon (or batch size) must be
+        rejected, not silently land the restored count on a reshaped LR
+        curve / diverged data order (RECOVERY.md; round-3 review
+        finding). With nothing to resume, drift is vacuous and allowed."""
+        import dataclasses
+
+        import pytest
+
+        from mpit_tpu.asyncsgd.config import TrainConfig
+        from mpit_tpu.asyncsgd.runner import run_meta
+        from mpit_tpu.train import CheckpointManager
+
+        cfg = TrainConfig(
+            steps=100, schedule="warmup_cosine", warmup_steps=10
+        )
+        cfg2 = dataclasses.replace(cfg, steps=80)  # reshaped decay horizon
+        with CheckpointManager(tmp_path / "ck", world8, async_save=False) as m:
+            m.ensure_meta(run_meta(cfg))
+            # No checkpoint saved yet: the pin is vacuous — a rerun with
+            # different flags re-pins instead of erroring (the run that
+            # wrote the meta died before its first save).
+            m.ensure_meta(run_meta(cfg2))
+            m.ensure_meta(run_meta(cfg))  # re-pin the original
+            m.save(1, {"x": jnp.zeros(8)})
+            m.wait()
+            # Same geometry with a real checkpoint: fine (clean resume).
+            m.ensure_meta(run_meta(cfg))
+        with CheckpointManager(tmp_path / "ck", world8, async_save=False) as m:
+            # Different --steps without --schedule-horizon: drift.
+            with pytest.raises(ValueError, match="schedule-horizon"):
+                m.ensure_meta(run_meta(cfg2))
+            # Data-order drift (batch size) is pinned too.
+            with pytest.raises(ValueError, match="batch_size"):
+                m.ensure_meta(run_meta(dataclasses.replace(cfg, batch_size=16)))
+            # Pinning the horizon to the original decay length: accepted.
+            cfg3 = dataclasses.replace(cfg, steps=80, schedule_horizon=100)
+            m.ensure_meta(run_meta(cfg3))
