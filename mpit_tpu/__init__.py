@@ -42,4 +42,4 @@ This package is NOT a port. It is a ground-up TPU-first (JAX / XLA / Pallas /
 
 __version__ = "0.1.0"
 
-from mpit_tpu.comm import init, World  # noqa: F401
+from mpit_tpu.comm import init, init_hybrid, World  # noqa: F401

@@ -63,6 +63,16 @@ class TrainConfig:
     ckpt_dir: str = ""  # orbax checkpoint directory ("" = no checkpoints)
     ckpt_every: int = 0
     eval_batch: int = 256
+    # Periodic full-val-split evaluation (top-1/top-5 sweep): every N
+    # steps, iterate the whole val split (runner.run_spmd eval hook);
+    # 0 = single held-out-batch eval at the end only.
+    eval_every: int = 0
+    eval_batches: int = 0  # cap the sweep (0 = full split; synthetic: 8)
+    # Input augmentation for the classification pipelines
+    # (data/augment.py): random shift-crop + horizontal flip on the
+    # train stream. The 58% top-1 north star is unreachable without it.
+    augment: bool = False
+    crop_pad: int = 4
     max_restores: int = 1  # checkpoint restores after a diverged loss
     spike_factor: float = 0.0  # >0: treat loss > factor*EMA as divergence
     seed: int = 0

@@ -62,7 +62,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         logits = model.apply({"params": params}, batch["image"])
         return {
             "loss": runner.softmax_xent(logits, batch["label"]),
-            "accuracy": runner.accuracy(logits, batch["label"]),
+            "top1": runner.accuracy(logits, batch["label"]),
         }
 
     stream = runner.make_stream(cfg, dataset)
@@ -74,6 +74,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         eval_fn=eval_fn,
         eval_batch=dataset.eval_batch(cfg.eval_batch),
         stream_factory=lambda skip: runner.make_stream(cfg, dataset, skip=skip),
+        val_sweep=runner.make_val_sweep(cfg, dataset),
     )
 
 

@@ -80,10 +80,13 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             batch["image"],
             train=False,
         )
-        return {
+        out = {
             "loss": runner.softmax_xent(logits, batch["label"]),
-            "accuracy": runner.accuracy(logits, batch["label"]),
+            "top1": runner.accuracy(logits, batch["label"]),
         }
+        if cfg.num_classes > 5:
+            out["top5"] = runner.topk_accuracy(logits, batch["label"], 5)
+        return out
 
     stream = runner.make_stream(cfg, dataset)
     return runner.run_spmd(
@@ -95,6 +98,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         eval_fn=eval_fn,
         eval_batch=dataset.eval_batch(cfg.eval_batch),
         stream_factory=lambda skip: runner.make_stream(cfg, dataset, skip=skip),
+        val_sweep=runner.make_val_sweep(cfg, dataset),
     )
 
 

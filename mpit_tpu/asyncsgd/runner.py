@@ -30,13 +30,10 @@ from mpit_tpu import opt as gopt
 from mpit_tpu.asyncsgd import actors
 from mpit_tpu.utils import profiling
 from mpit_tpu.asyncsgd.config import TrainConfig
-from mpit_tpu.data import Prefetcher
 from mpit_tpu.train import (
     CheckpointManager,
-    Diverged,
-    DivergenceGuard,
     MetricLogger,
-    Throughput,
+    hardened_loop,
     make_eval_step,
     make_train_step,
 )
@@ -51,15 +48,43 @@ def accuracy(logits, labels):
     return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
 
 
+def topk_accuracy(logits, labels, k: int = 5):
+    """Top-k accuracy (the ImageNet top-5 convention)."""
+    _, idx = jax.lax.top_k(logits, k)
+    return jnp.mean(jnp.any(idx == labels[:, None], axis=-1).astype(jnp.float32))
+
+
 def classification_dataset(cfg: TrainConfig, synthetic_factory):
     """``--data-dir`` selects the on-disk dataset (``data/filedata.py``,
     the reference's real-MNIST/ImageNet role); else the synthetic
-    stand-in from ``synthetic_factory()``."""
+    stand-in from ``synthetic_factory()``. ``--augment`` turns on the
+    train-stream shift-crop + hflip either way (data/augment.py)."""
     if cfg.data_dir:
         from mpit_tpu.data import FileClassification
 
-        return FileClassification(cfg.data_dir, seed=cfg.seed)
-    return synthetic_factory()
+        return FileClassification(
+            cfg.data_dir,
+            seed=cfg.seed,
+            augment=cfg.augment,
+            crop_pad=cfg.crop_pad,
+        )
+    ds = synthetic_factory()
+    ds.augment = cfg.augment
+    ds.crop_pad = cfg.crop_pad
+    return ds
+
+
+def make_val_sweep(cfg: TrainConfig, dataset):
+    """``() -> iterator`` over the val split for the periodic top-1/top-5
+    sweep (``run_spmd``'s ``val_sweep``). ``--eval-batches`` caps it; the
+    synthetic datasets default to 8 held-out batches."""
+
+    def sweep():
+        return dataset.val_batches(
+            cfg.eval_batch, num_batches=cfg.eval_batches or None
+        )
+
+    return sweep
 
 
 def make_stream(cfg: TrainConfig, dataset, *args, skip: int = 0):
@@ -110,6 +135,8 @@ def run_meta(cfg: TrainConfig) -> dict:
         "seed": cfg.seed,
         "data_dir": os.path.abspath(cfg.data_dir) if cfg.data_dir else "",
         "stream_impl": "native_core" if uses_native_core else "python",
+        "augment": cfg.augment,
+        "crop_pad": cfg.crop_pad if cfg.augment else 0,
         "easgd": cfg.easgd,
     }
     if cfg.easgd:
@@ -149,6 +176,7 @@ def run_spmd(
     eval_fn: Callable | None = None,
     eval_batch: dict | None = None,
     stream_factory: Callable | None = None,
+    val_sweep: Callable | None = None,
 ) -> dict:
     """Drive the jitted SPMD train step for ``cfg.steps`` steps.
 
@@ -166,6 +194,12 @@ def run_spmd(
       stream_factory: ``skip -> iterator`` rebuilding the batch stream
         fast-forwarded past ``skip`` batches (checkpoint resume without
         materializing the skipped range; see :func:`make_stream`).
+      val_sweep: ``() -> finite iterator`` over the whole val split
+        (:func:`make_val_sweep`). With ``eval_fn``, enables the periodic
+        full-split top-1/top-5 sweep: every ``cfg.eval_every`` steps (and
+        at the last step) the sweep's averaged metrics are logged as
+        ``eval_*`` rows in the metrics JSONL — the accuracy curve the 58%
+        top-1 north star is read from (BASELINE.json).
     """
     world = mpit_tpu.init(cfg.mesh_shape())
     axis = "data"
@@ -189,7 +223,6 @@ def run_spmd(
             state = ckpt.restore(state, state_specs(params, extra))
 
     logger = MetricLogger()
-    meter = Throughput()
     start_step = int(state.step)
     # Resume continues the stream, not restarts it: skip the batches the
     # checkpointed steps already consumed so the resumed trajectory matches
@@ -214,156 +247,71 @@ def run_spmd(
     # Per-step ICI traffic model (SURVEY.md §6 metrics row), logged once.
     # Gradient sync rides the data axis only, so size by that axis (a
     # multi-axis mesh's model/pipe dims don't carry grad allreduce).
-    comm = profiling.CommModel(params, world.axis_size(axis), zero1=cfg.zero1)
+    comm = profiling.CommModel(
+        params,
+        world.axis_size(axis),
+        zero1=cfg.zero1,
+        num_slices=world.dcn_factor(axis),
+    )
     logger.log(start_step, {"comm_" + k: v for k, v in comm.summary().items()})
 
-    # Trace a small window past compile/warmup — steps 2..5 of this run,
-    # clamped into range so short runs still capture something.
-    prof_window = None
-    if cfg.profile_dir and cfg.steps > start_step:
-        last = cfg.steps - 1
-        prof_window = (min(start_step + 2, last), min(start_step + 5, last))
-    # Failure detection (SURVEY.md §6): a non-finite/spiking loss at a
-    # checked step triggers a restore (when checkpoints exist) and the run
-    # continues — up to cfg.max_restores times. Checks run at BOTH log and
-    # save points, so a checkpoint is never written on a failing loss.
-    # (Residual window: loss at step t certifies the params *entering* t,
-    # so the state saved at t could in principle already be poisoned while
-    # loss_t is finite — which is why repeat divergence steps back to an
-    # OLDER checkpoint instead of reloading the same one.) After a restore
-    # the stream keeps its position: an interrupted data order is part of
-    # divergence recovery; exact replay is only for clean resume.
-    guard_ = DivergenceGuard(spike_factor=cfg.spike_factor)
-    restores = 0
-    restore_before: int | None = None  # ceiling for the next restore target
+    # Periodic full-val-split evaluation: average eval_fn's metrics over
+    # the whole sweep (equal-sized batches, so the plain mean is the
+    # per-example mean; remainder rows are dropped by val_batches).
+    # Gated on --eval-every > 0, per config.py: the default remains the
+    # cheap single held-out-batch eval at the end.
+    eval_hook = None
+    if cfg.eval_every and eval_fn is not None and val_sweep is not None:
+        ev_sweep = make_eval_step(eval_fn, world, axis=axis)
+        from mpit_tpu.data import shard_batch as _shard
 
-    # Preemption drain (SURVEY.md §6 recovery row; RECOVERY.md): pod
-    # maintenance/eviction delivers SIGTERM with a grace window. Catch it,
-    # finish the in-flight step, write a final checkpoint, and exit
-    # cleanly so the rescheduled job resumes from it — checkpoint-restart
-    # IS the partial-restart story (JAX SPMD cannot hot-swap pod members;
-    # the restarted world must present the same mesh axis sizes).
-    preempted = {"flag": False}
+        def eval_hook(state):
+            totals: dict[str, float] = {}
+            n = 0
+            for b in val_sweep():
+                m = ev_sweep(state, _shard(world, b, axis=axis))
+                for k, v in m.items():
+                    totals[k] = totals.get(k, 0.0) + float(v)
+                n += 1
+            return {k: v / n for k, v in totals.items()} if n else {}
 
-    def _on_term(signum, frame):
-        del signum, frame
-        preempted["flag"] = True
+    # The hardened drive loop — prefetch, preemption drain, divergence
+    # guard + older-checkpoint backoff, profile window — shared with the
+    # gpt2 parallel tiers (train/loop.py; RECOVERY.md).
+    result = hardened_loop(
+        world,
+        state,
+        step_fn,
+        batches,
+        steps=cfg.steps,
+        axis=axis,
+        items_per_batch=items,
+        log_every=cfg.log_every,
+        logger=logger,
+        ckpt=ckpt,
+        ckpt_every=cfg.ckpt_every,
+        specs=lambda: state_specs(params, extra),
+        max_restores=cfg.max_restores,
+        spike_factor=cfg.spike_factor,
+        profile_dir=cfg.profile_dir,
+        eval_every=cfg.eval_every if eval_hook else 0,
+        eval_hook=eval_hook,
+    )
+    state = result["state"]
 
-    prev_handler = None
-    handler_installed = False
-    try:
-        import signal
-
-        prev_handler = signal.signal(signal.SIGTERM, _on_term)
-        handler_installed = True
-    except ValueError:
-        pass  # not the main thread (tests, embedded use): no handler
-
-    loss_trace: list[tuple[int, float]] = []
-    tracing = False
-    trace_done = False
-    step = start_step
-    try:
-        with Prefetcher(world, batches, axis=axis) as stream:
-            for batch in stream:
-                if step >= cfg.steps:
-                    break
-                if preempted["flag"]:
-                    if ckpt:
-                        ckpt.save(step, state)
-                        ckpt.wait()
-                    logger.log(
-                        step,
-                        {"event": "preempted_checkpoint_and_exit",
-                         "resumable": bool(ckpt)},
-                    )
-                    break
-                if (
-                    prof_window
-                    and not tracing
-                    and not trace_done
-                    and step == prof_window[0]
-                ):
-                    jax.profiler.start_trace(cfg.profile_dir)
-                    tracing = True
-                state, metrics = step_fn(state, batch)
-                if tracing and step >= prof_window[1]:
-                    float(metrics["loss"])  # host fetch: trace covers real work
-                    jax.profiler.stop_trace()
-                    tracing = False
-                    trace_done = True
-                rate = meter.tick(items)
-                should_log = (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps
-                should_save = bool(
-                    ckpt and cfg.ckpt_every and (step + 1) % cfg.ckpt_every == 0
-                )
-                if should_log or should_save:
-                    loss = float(metrics["loss"])
-                    try:
-                        guard_.check(step + 1, loss)
-                    except Diverged:
-                        candidates = [
-                            s
-                            for s in (ckpt.all_steps() if ckpt else [])
-                            if restore_before is None or s < restore_before
-                        ]
-                        if not candidates or restores >= cfg.max_restores:
-                            raise
-                        target = max(candidates)
-                        restores += 1
-                        state = ckpt.restore(
-                            state, state_specs(params, extra), step=target
-                        )
-                        step = int(state.step)
-                        restore_before = target
-                        guard_.reset()
-                        loss_trace = [(s, l) for s, l in loss_trace if s <= step]
-                        logger.log(
-                            step,
-                            {"event": "restored_after_divergence",
-                             "bad_loss": loss, "restores": restores},
-                        )
-                        continue
-                    if should_log:
-                        loss_trace.append((step + 1, loss))
-                        logger.log(
-                            step + 1,
-                            {**{k: float(v) for k, v in metrics.items()},
-                             "items_per_sec": rate},
-                        )
-                    if should_save:
-                        ckpt.save(step + 1, state)
-                        # A new guard-passing checkpoint supersedes the
-                        # poisoned-latest suspicion from a past restore.
-                        restore_before = None
-                step += 1
-    finally:
-        if tracing:  # run ended (or raised) inside the window
-            jax.profiler.stop_trace()
-        if handler_installed:
-            # Restore unconditionally (getsignal-None priors included —
-            # prev_handler None means "installed outside Python", and
-            # SIG_DFL is the closest restorable equivalent).
-            import signal
-
-            signal.signal(
-                signal.SIGTERM,
-                prev_handler if prev_handler is not None else signal.SIG_DFL,
-            )
-    if ckpt:
-        ckpt.wait()
-
-    losses = [l for _, l in loss_trace]
     out = {
         "mode": "spmd",
         "world": repr(mpit_tpu.comm.get_world()),
-        "steps": int(state.step),
-        "losses": losses,
-        "final_loss": losses[-1] if losses else float("nan"),
-        "restores": restores,
-        "preempted": preempted["flag"],
+        "steps": result["steps"],
+        "losses": result["losses"],
+        "final_loss": result["final_loss"],
+        "restores": result["restores"],
+        "preempted": result["preempted"],
     }
-    if eval_fn is not None and eval_batch is not None:
+    if "eval" in result:
+        # The last full-val-split sweep (the authoritative number).
+        out["eval"] = result["eval"]
+    elif eval_fn is not None and eval_batch is not None:
         ev = make_eval_step(eval_fn, world, axis=axis)
         from mpit_tpu.data import shard_batch
 

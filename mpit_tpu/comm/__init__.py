@@ -17,7 +17,7 @@ binding + the ``mpiT`` Lua module; SURVEY.md §2) with:
   all-gather / all-reduce, benchmarked for the "allreduce GB/s" metric.
 """
 
-from mpit_tpu.comm.mesh import World, init, get_world, local_mesh
+from mpit_tpu.comm.mesh import World, init, init_hybrid, get_world, local_mesh
 from mpit_tpu.comm.collectives import (
     allgather,
     allreduce,
@@ -39,6 +39,7 @@ from mpit_tpu.comm.collectives import (
 __all__ = [
     "World",
     "init",
+    "init_hybrid",
     "get_world",
     "local_mesh",
     "allreduce",

@@ -59,6 +59,13 @@ class World:
     """
 
     mesh: Mesh
+    # DCN factorization (hybrid multi-slice worlds, :func:`init_hybrid`):
+    # axis name -> how many SLICES that axis spans. An axis absent here is
+    # entirely intra-slice (ICI). E.g. {"data": 4} on a 32-device world of
+    # 4 slices: the data axis is 4 slices x (per-slice chips), and its
+    # collectives cross DCN at the slice boundary. Cost models
+    # (utils/profiling.CommModel) read this to price ICI vs DCN hops.
+    dcn_axes: Any = None
 
     # ----- topology queries ------------------------------------------------
     @property
@@ -75,6 +82,17 @@ class World:
 
     def axis_size(self, axis: str) -> int:
         return self.mesh.shape[axis]
+
+    def dcn_factor(self, axis: str) -> int:
+        """How many slices ``axis`` spans (1 = pure-ICI axis)."""
+        return (self.dcn_axes or {}).get(axis, 1)
+
+    @property
+    def num_slices(self) -> int:
+        out = 1
+        for v in (self.dcn_axes or {}).values():
+            out *= v
+        return out
 
     @property
     def process_index(self) -> int:
@@ -230,6 +248,104 @@ def init(
         mesh = Mesh(dev_array, tuple(axis_shapes.keys()), axis_types=axis_types)
 
     world = World(mesh=mesh)
+    if set_default:
+        global _DEFAULT_WORLD
+        with _LOCK:
+            _DEFAULT_WORLD = world
+    return world
+
+
+def _slice_groups(devs: Sequence[Any], num_slices: int) -> list[list[Any]]:
+    """Group devices by slice. Real multi-slice TPU devices carry a
+    ``slice_index``; environments without one (the fake CPU mesh, single
+    -slice chips) fall back to contiguous equal chunks as *virtual*
+    slices — the layout math and cost accounting are identical, which is
+    what makes the hybrid path testable on 1 host (SURVEY.md §5.2)."""
+    by_slice: dict[int, list[Any]] = {}
+    if all(getattr(d, "slice_index", None) is not None for d in devs):
+        for d in devs:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        if len(by_slice) != num_slices:
+            raise ValueError(
+                f"devices report {len(by_slice)} slices, expected {num_slices}"
+            )
+        return [by_slice[k] for k in sorted(by_slice)]
+    n = len(devs)
+    if n % num_slices:
+        raise ValueError(
+            f"{n} devices not divisible into {num_slices} virtual slices"
+        )
+    per = n // num_slices
+    return [list(devs[i * per : (i + 1) * per]) for i in range(num_slices)]
+
+
+def init_hybrid(
+    axis_shapes: Mapping[str, int],
+    dcn_axes: Mapping[str, int],
+    *,
+    devices: Sequence[Any] | None = None,
+    set_default: bool = True,
+) -> World:
+    """Bootstrap a DCN-aware multi-slice world (SURVEY.md §3.4 transport:
+    "ICI (intra-slice) and DCN (cross-slice)").
+
+    The jax ``create_hybrid_device_mesh`` pattern, re-expressed in this
+    framework's named-axis vocabulary: each mesh axis ``a`` has total size
+    ``axis_shapes[a]``, of which ``dcn_axes.get(a, 1)`` spans slices (the
+    slow DCN hops) and the rest stays inside a slice (ICI). Devices are
+    laid out slice-major per axis, so e.g. ``data=8`` with
+    ``dcn_axes={"data": 4}`` puts 4 DCN groups of 2 ICI-adjacent chips on
+    the data axis — gradient allreduce then decomposes into a fast
+    intra-slice phase and a small cross-slice phase, which is also
+    exactly how the cost model prices it
+    (``utils/profiling.CommModel``).
+
+    Model/pipe/seq axes should stay pure-ICI (omit them from
+    ``dcn_axes``): their collectives are latency/bandwidth-critical per
+    layer, while the data axis syncs once per step — the standard
+    slice-topology recipe.
+    """
+    axis_shapes = dict(axis_shapes)
+    dcn_axes = {k: int(v) for k, v in dcn_axes.items() if int(v) != 1}
+    unknown = set(dcn_axes) - set(axis_shapes)
+    if unknown:
+        raise ValueError(f"dcn_axes name unknown mesh axes: {sorted(unknown)}")
+    num_slices = math.prod(dcn_axes.values()) if dcn_axes else 1
+    for a, f in dcn_axes.items():
+        if axis_shapes[a] % f:
+            raise ValueError(
+                f"axis {a!r} size {axis_shapes[a]} not divisible by its "
+                f"DCN factor {f}"
+            )
+
+    _maybe_distributed_initialize()
+    devs = list(devices) if devices is not None else jax.devices()
+    ndev = len(devs)
+    if math.prod(axis_shapes.values()) != ndev:
+        raise ValueError(
+            f"mesh shape {axis_shapes} does not cover {ndev} devices"
+        )
+    groups = _slice_groups(devs, num_slices)
+
+    # Device array construction: [dcn_a, dcn_b, ..., ici_a, ici_b, ...]
+    # (slice grid first, per-slice grid second), then interleave each
+    # axis's (dcn, ici) pair adjacently and merge — slice-major ordering
+    # per axis.
+    names = list(axis_shapes)
+    dcn_sizes = [dcn_axes.get(a, 1) for a in names]
+    ici_sizes = [axis_shapes[a] // dcn_axes.get(a, 1) for a in names]
+    arr = np.empty((num_slices, ndev // max(num_slices, 1)), dtype=object)
+    for i, g in enumerate(groups):
+        arr[i] = g
+    arr = arr.reshape(*dcn_sizes, *ici_sizes)
+    k = len(names)
+    perm = [x for i in range(k) for x in (i, k + i)]  # (dcn_i, ici_i) pairs
+    arr = arr.transpose(perm).reshape(
+        tuple(d * c for d, c in zip(dcn_sizes, ici_sizes))
+    )
+    axis_types = (jax.sharding.AxisType.Auto,) * k
+    mesh = Mesh(arr, tuple(names), axis_types=axis_types)
+    world = World(mesh=mesh, dcn_axes=dcn_axes or None)
     if set_default:
         global _DEFAULT_WORLD
         with _LOCK:

@@ -1,0 +1,63 @@
+"""Input augmentation for the classification pipelines (host-side numpy).
+
+The reference's ImageNet pipeline crops and flips on the host before
+handing batches to the trainer (Torch dataset transforms; SURVEY.md §3.2
+A5) — AlexNet-class training does not reach the 58% top-1 north star
+(BASELINE.json) without it. TPU-natively the same split applies:
+augmentation is cheap pointer math on the host (it runs on the prefetch
+thread, overlapped with device compute), while the device sees only
+dense float batches of static shape.
+
+Two transforms, the classic pair:
+
+- **pad-and-crop**: zero-pad by ``pad`` pixels, crop back to H×W at a
+  per-image random offset — equivalently a random shift in
+  ``[-pad, pad]²`` with zero fill. Static output shape (XLA-friendly).
+- **horizontal flip** with probability 1/2 per image.
+
+Determinism: the caller supplies the RNG; the datasets derive it from a
+counter-based per-batch seed, so augmentation replays exactly across
+checkpoint resume (``skip=N`` draws nothing for skipped batches) and is
+independent of thread count. The C++ core applies the same transforms in
+its worker threads (``native/data_loader.cpp``) with its own per-ticket
+streams — bit-different, distribution-identical (the established native
+contract, ``tests/test_native.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def augment_images(
+    images: np.ndarray,
+    rng: np.random.RandomState,
+    *,
+    pad: int = 4,
+    hflip: bool = True,
+) -> np.ndarray:
+    """Random shift (zero-fill pad-and-crop) + horizontal flip, per image.
+
+    ``images``: ``[B, H, W, C]`` float32. Returns a fresh array (the
+    input is never written — Prefetcher owned-buffer contract).
+    """
+    images = np.asarray(images)
+    if images.ndim != 4:
+        raise ValueError(f"expected [B,H,W,C] images, got {images.shape}")
+    b, h, w, _ = images.shape
+    if pad:
+        ys = rng.randint(0, 2 * pad + 1, size=b)
+        xs = rng.randint(0, 2 * pad + 1, size=b)
+        padded = np.zeros(
+            (b, h + 2 * pad, w + 2 * pad, images.shape[3]), images.dtype
+        )
+        padded[:, pad : pad + h, pad : pad + w] = images
+        out = np.empty_like(images)
+        for i in range(b):
+            out[i] = padded[i, ys[i] : ys[i] + h, xs[i] : xs[i] + w]
+    else:
+        out = images.copy()
+    if hflip:
+        flips = rng.randint(0, 2, size=b).astype(bool)
+        out[flips] = out[flips, :, ::-1]
+    return out

@@ -80,6 +80,13 @@ class FileClassification:
     data_dir: str
     seed: int = 0
     normalize: bool = True  # uint8 -> float32 in [0, 1)
+    # Train-split augmentation (data/augment.py): random shift-crop +
+    # horizontal flip. Applied to batches() only — eval_batch/val_batches
+    # always see clean images. Per-batch counter-seeded, so skip=N resume
+    # replays the augmented stream exactly.
+    augment: bool = False
+    crop_pad: int = 4
+    hflip: bool = True
 
     def __post_init__(self):
         with open(os.path.join(self.data_dir, _META)) as f:
@@ -135,7 +142,8 @@ class FileClassification:
             raise ValueError(
                 f"batch_size {batch_size} exceeds dataset size {n}"
             )
-        rng = np.random.RandomState(self.seed + 1 if seed is None else seed)
+        base = self.seed + 1 if seed is None else seed
+        rng = np.random.RandomState(base)
         produced = 0
         while True:
             order = rng.permutation(n)
@@ -144,10 +152,54 @@ class FileClassification:
                     produced += 1
                     continue
                 idx = np.sort(order[lo : lo + batch_size])  # mmap-friendly
-                yield {
-                    "image": self._assemble(self._images[idx]),
-                    "label": self._labels[idx],
-                }
+                images = self._assemble(self._images[idx])
+                if self.augment:
+                    from mpit_tpu.data.augment import augment_images
+
+                    # Counter-based per-batch RNG (independent of the
+                    # epoch-permutation stream): augmentation replays
+                    # across seek-based resume without drawing for the
+                    # skipped range.
+                    arng = np.random.RandomState(
+                        (base * 2_000_003 + produced) % 2**31
+                    )
+                    images = augment_images(
+                        images, arng, pad=self.crop_pad, hflip=self.hflip
+                    )
+                produced += 1
+                yield {"image": images, "label": self._labels[idx]}
+
+    @property
+    def val_size(self) -> int:
+        """Rows in the val split (train split if no val files exist)."""
+        return len(
+            self._val_images if self._val_images is not None else self._images
+        )
+
+    def val_batches(
+        self, batch_size: int, *, num_batches: int | None = None
+    ) -> Iterator[dict[str, np.ndarray]]:
+        """Ordered sweep over the whole val split (train if absent) — the
+        full top-1 evaluation pass (BASELINE.json north star is measured
+        on it). Finite iterator; the last partial batch is dropped
+        (static shapes), so coverage is ``floor(n/B)·B`` rows.
+        ``num_batches`` caps the sweep (tests / quick evals). Never
+        augmented."""
+        images, labels = self._val_images, self._val_labels
+        if images is None:
+            images, labels = self._images, self._labels
+        n = len(images)
+        total = n // batch_size
+        if num_batches is not None:
+            total = min(total, num_batches)
+        for b in range(total):
+            lo = b * batch_size
+            yield {
+                "image": self._assemble(images[lo : lo + batch_size]),
+                "label": np.asarray(labels[lo : lo + batch_size]).astype(
+                    np.int32
+                ),
+            }
 
     def eval_batch(self, batch_size: int, *, seed: int = 10_000):
         """One deterministic batch from the val split (train if absent)."""

@@ -71,12 +71,25 @@ class Prefetcher:
 
     _SENTINEL = object()
 
-    def __init__(self, world, it: Iterator, *, axis: str = "data", depth: int = 2):
+    def __init__(
+        self,
+        world,
+        it: Iterator,
+        *,
+        axis: str = "data",
+        depth: int = 2,
+        transform=None,
+    ):
+        """``transform`` overrides the host→device placement (default:
+        ``shard_batch`` over ``axis``) — the parallel tiers pass their own
+        slice-and-shard (custom PartitionSpecs) and get prefetch for
+        free."""
         self._world = world
         self._axis = axis
         self._queue: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._exc: BaseException | None = None
+        tf = transform or (lambda b: shard_batch(world, b, axis=axis))
 
         def worker():
             try:
@@ -90,7 +103,7 @@ class Prefetcher:
                     # yielded memory (e.g. the native slot ring with
                     # copy=False) cannot be made safe here — which is why
                     # the native loader copies at its boundary by default.
-                    self._queue.put(shard_batch(world, batch, axis=axis))
+                    self._queue.put(tf(batch))
             except BaseException as e:  # surfaced on next __next__
                 self._exc = e
             finally:

@@ -46,23 +46,29 @@ def _load() -> ctypes.CDLL | None:
             return _LIB
         if _BUILD_ERROR is not None:
             return None
-        if not _LIB_PATH.exists():
-            try:
-                subprocess.run(
-                    ["make", "-C", str(_NATIVE_DIR)],
-                    check=True,
-                    capture_output=True,
-                    text=True,
-                )
-            except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        # Always invoke make: it no-ops when the .so is fresh and rebuilds
+        # when the C++ source is newer (a stale pre-upgrade .so would lack
+        # newer symbols, e.g. mpit_cls_create_aug).
+        try:
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            if not _LIB_PATH.exists():
                 _BUILD_ERROR = getattr(e, "stderr", str(e)) or str(e)
                 return None
         try:
             lib = ctypes.CDLL(str(_LIB_PATH))
-        except OSError as e:
+            _declare(lib)
+        except (OSError, AttributeError) as e:
+            # AttributeError: a stale pre-upgrade .so survived a failed
+            # rebuild and lacks newer symbols — degrade to the Python
+            # generators like any other unavailable-native case.
             _BUILD_ERROR = str(e)
             return None
-        _declare(lib)
         _LIB = lib
         return lib
 
@@ -73,6 +79,12 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.mpit_cls_create.argtypes = [
         c.POINTER(c.c_float), c.c_int, c.c_int64, c.c_float, c.c_uint64,
         c.c_int, c.c_int, c.c_int,
+    ]
+    lib.mpit_cls_create_aug.restype = c.c_void_p
+    lib.mpit_cls_create_aug.argtypes = [
+        c.POINTER(c.c_float), c.c_int, c.c_int64, c.c_float, c.c_uint64,
+        c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+        c.c_int,
     ]
     lib.mpit_cls_image_ptr.restype = c.POINTER(c.c_float)
     lib.mpit_cls_image_ptr.argtypes = [c.c_void_p, c.c_int]
@@ -193,12 +205,17 @@ def classification_stream(
     depth: int = 4,
     threads: int = 2,
     copy: bool = True,
+    augment: bool = False,
+    crop_pad: int = 4,
+    hflip: bool = True,
 ) -> Iterator[dict[str, np.ndarray]]:
     """Native prototype+noise stream: ``{"image", "label"}`` batches.
 
     ``prototypes``: float32 ``[num_classes, *sample_shape]``. Raises
     ``RuntimeError`` if the native core is unavailable (callers that want
-    graceful degradation check :func:`available` first).
+    graceful degradation check :func:`available` first). ``augment``
+    applies the in-worker shift-crop + hflip (requires ``[H, W, C]``
+    samples; same transforms as ``data/augment.py``).
     """
     lib = _load()
     if lib is None:
@@ -208,10 +225,22 @@ def classification_stream(
     num_classes = protos.shape[0]
     sample_shape = protos.shape[1:]
     elems = int(np.prod(sample_shape))
-    handle = lib.mpit_cls_create(
-        protos.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        num_classes, elems, float(noise), seed, batch_size, depth, threads,
-    )
+    if augment:
+        if len(sample_shape) != 3:
+            raise ValueError(
+                f"augment requires [H, W, C] samples, got {sample_shape}"
+            )
+        h, w, ch = (int(d) for d in sample_shape)
+        handle = lib.mpit_cls_create_aug(
+            protos.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            num_classes, elems, float(noise), seed, batch_size, depth,
+            threads, h, w, ch, int(crop_pad), int(bool(hflip)),
+        )
+    else:
+        handle = lib.mpit_cls_create(
+            protos.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            num_classes, elems, float(noise), seed, batch_size, depth, threads,
+        )
     views = {}
     for s in range(depth):
         img = np.ctypeslib.as_array(

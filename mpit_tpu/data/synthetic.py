@@ -30,12 +30,30 @@ class SyntheticClassification:
     num_classes: int = 10
     noise: float = 0.3
     seed: int = 0
+    # Train-stream augmentation (data/augment.py): random shift-crop +
+    # hflip, drawn from the same counter-based per-batch RNG (skip-safe).
+    # eval_batch/val_batches are never augmented.
+    augment: bool = False
+    crop_pad: int = 4
+    hflip: bool = True
 
     def __post_init__(self):
         rng = np.random.RandomState(self.seed)
         self.prototypes = rng.randn(self.num_classes, *self.image_shape).astype(
             np.float32
         )
+
+    def _raw_batch(self, batch_size: int, base: int, idx: int):
+        """One un-augmented batch + its (partially consumed) RNG — the
+        shared generator for the train stream (which may augment with
+        further draws from the same RNG) and the always-clean eval/val
+        paths."""
+        rng = np.random.RandomState((base * 1_000_003 + idx) % 2**31)
+        labels = rng.randint(0, self.num_classes, size=(batch_size,))
+        images = self.prototypes[labels] + self.noise * rng.randn(
+            batch_size, *self.image_shape
+        ).astype(np.float32)
+        return images.astype(np.float32), labels.astype(np.int32), rng
 
     def batches(
         self, batch_size: int, *, seed: int | None = None, skip: int = 0
@@ -50,16 +68,29 @@ class SyntheticClassification:
         base = self.seed + 1 if seed is None else seed
         idx = skip
         while True:
-            rng = np.random.RandomState((base * 1_000_003 + idx) % 2**31)
+            images, labels, rng = self._raw_batch(batch_size, base, idx)
             idx += 1
-            labels = rng.randint(0, self.num_classes, size=(batch_size,))
-            images = self.prototypes[labels] + self.noise * rng.randn(
-                batch_size, *self.image_shape
-            ).astype(np.float32)
-            yield {"image": images.astype(np.float32), "label": labels.astype(np.int32)}
+            if self.augment:
+                from mpit_tpu.data.augment import augment_images
+
+                images = augment_images(
+                    images, rng, pad=self.crop_pad, hflip=self.hflip
+                )
+            yield {"image": images, "label": labels}
 
     def eval_batch(self, batch_size: int, *, seed: int = 10_000):
-        return next(self.batches(batch_size, seed=seed))
+        images, labels, _ = self._raw_batch(batch_size, seed, 0)
+        return {"image": images, "label": labels}
+
+    def val_batches(
+        self, batch_size: int, *, num_batches: int | None = None
+    ):
+        """Finite deterministic sweep of held-out batches (the synthetic
+        stand-in for a val split; seeds disjoint from the train stream).
+        Never augmented."""
+        for i in range(num_batches if num_batches is not None else 8):
+            images, labels, _ = self._raw_batch(batch_size, 20_000 + i, 0)
+            yield {"image": images, "label": labels}
 
     def native_batches(
         self,
@@ -85,6 +116,9 @@ class SyntheticClassification:
             batch_size=batch_size,
             seed=self.seed + 1 if seed is None else seed,
             threads=threads,
+            augment=self.augment,
+            crop_pad=self.crop_pad,
+            hflip=self.hflip,
         )
         for _ in range(skip):
             next(stream)

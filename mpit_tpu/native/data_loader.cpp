@@ -174,19 +174,31 @@ struct ClsLoader {
   float noise;
   uint64_t seed;
   int batch;
+  // Augmentation (mirrors data/augment.py: random shift in [-pad, pad]^2
+  // with zero fill + horizontal flip, applied to the noisy image). Needs
+  // the image geometry; height*width*channels == sample_elems. pad == 0
+  // and hflip == 0 is the identity (the pre-augmentation loader).
+  int height, width, channels, pad;
+  bool hflip;
   SlotRing ring;
   std::vector<std::vector<float>> images;  // per slot: [batch * sample_elems]
   std::vector<std::vector<int32_t>> labels;  // per slot: [batch]
   std::vector<std::thread> workers;
 
   ClsLoader(const float* p, int nc, int64_t elems, float nz, uint64_t sd,
-            int b, int depth, int nthreads)
+            int b, int depth, int nthreads, int h, int w, int c, int pd,
+            bool flip)
       : protos(p, p + nc * elems),
         sample_elems(elems),
         num_classes(nc),
         noise(nz),
         seed(sd),
         batch(b),
+        height(h),
+        width(w),
+        channels(c),
+        pad(pd),
+        hflip(flip),
         ring(depth),
         images(depth),
         labels(depth) {
@@ -194,12 +206,15 @@ struct ClsLoader {
       images[i].resize(static_cast<size_t>(batch) * elems);
       labels[i].resize(batch);
     }
-    for (int w = 0; w < nthreads; ++w) {
+    for (int wk = 0; wk < nthreads; ++wk) {
       workers.emplace_back([this] { run(); });
     }
   }
 
   void run() {
+    const bool aug = (pad > 0 || hflip) && height > 0 && width > 0;
+    std::vector<float> tmp;  // per-worker scratch: one noisy sample
+    if (aug) tmp.resize(sample_elems);
     while (true) {
       auto [slot, ticket] = ring.claim_free();
       if (slot < 0) return;
@@ -207,12 +222,36 @@ struct ClsLoader {
       float* img = images[slot].data();
       int32_t* lab = labels[slot].data();
       for (int i = 0; i < batch; ++i) {
-        int32_t c = static_cast<int32_t>(rng.below(num_classes));
-        lab[i] = c;
-        const float* proto = protos.data() + static_cast<size_t>(c) * sample_elems;
+        int32_t cls = static_cast<int32_t>(rng.below(num_classes));
+        lab[i] = cls;
+        const float* proto =
+            protos.data() + static_cast<size_t>(cls) * sample_elems;
         float* dst = img + static_cast<size_t>(i) * sample_elems;
+        float* gen = aug ? tmp.data() : dst;
         for (int64_t e = 0; e < sample_elems; ++e) {
-          dst[e] = proto[e] + noise * rng.normal();
+          gen[e] = proto[e] + noise * rng.normal();
+        }
+        if (aug) {
+          // Shift + flip of the noisy image, zero fill out of bounds —
+          // identical semantics to augment_images (pad-and-crop where
+          // dy = crop_offset - pad).
+          const int dy = pad ? static_cast<int>(rng.below(2 * pad + 1)) - pad : 0;
+          const int dx = pad ? static_cast<int>(rng.below(2 * pad + 1)) - pad : 0;
+          const bool flip = hflip && (rng.next() & 1);
+          for (int y = 0; y < height; ++y) {
+            const int sy = y + dy;
+            for (int x = 0; x < width; ++x) {
+              const int sx = (flip ? width - 1 - x : x) + dx;
+              float* out = dst + (static_cast<size_t>(y) * width + x) * channels;
+              if (sy < 0 || sy >= height || sx < 0 || sx >= width) {
+                for (int ch = 0; ch < channels; ++ch) out[ch] = 0.0f;
+              } else {
+                const float* src =
+                    tmp.data() + (static_cast<size_t>(sy) * width + sx) * channels;
+                for (int ch = 0; ch < channels; ++ch) out[ch] = src[ch];
+              }
+            }
+          }
         }
       }
       ring.push_ready(slot, ticket);
@@ -290,7 +329,19 @@ void* mpit_cls_create(const float* protos, int num_classes, int64_t sample_elems
                       float noise, uint64_t seed, int batch, int depth,
                       int threads) {
   return new ClsLoader(protos, num_classes, sample_elems, noise, seed, batch,
-                       depth, threads);
+                       depth, threads, /*h=*/0, /*w=*/0, /*c=*/0, /*pad=*/0,
+                       /*flip=*/false);
+}
+
+// Augmenting variant: image geometry + random shift-crop (pad) + hflip,
+// the native counterpart of data/augment.py.
+void* mpit_cls_create_aug(const float* protos, int num_classes,
+                          int64_t sample_elems, float noise, uint64_t seed,
+                          int batch, int depth, int threads, int height,
+                          int width, int channels, int pad, int hflip) {
+  return new ClsLoader(protos, num_classes, sample_elems, noise, seed, batch,
+                       depth, threads, height, width, channels, pad,
+                       hflip != 0);
 }
 
 // Buffer addresses for slot `i` (stable for the loader's lifetime), so the

@@ -16,7 +16,7 @@ along the data axis and optimizer state sharded across chips.
 
 from mpit_tpu.train.guard import Diverged, DivergenceGuard
 from mpit_tpu.train.step import TrainState, make_eval_step, make_train_step
-from mpit_tpu.train.loop import Trainer
+from mpit_tpu.train.loop import Trainer, hardened_loop
 from mpit_tpu.train.checkpoint import CheckpointManager
 from mpit_tpu.train.metrics import MetricLogger, Throughput
 
@@ -27,6 +27,7 @@ __all__ = [
     "make_train_step",
     "make_eval_step",
     "Trainer",
+    "hardened_loop",
     "CheckpointManager",
     "MetricLogger",
     "Throughput",

@@ -4,6 +4,14 @@ The reference's loop is the per-worker ``for each minibatch`` in its
 ``asyncsgd/`` scripts plus the server's message loop (SURVEY.md §4.2); here
 a single :class:`Trainer` drives the jitted SPMD step over a prefetched
 sharded data stream.
+
+:func:`hardened_loop` is the production drive loop shared by every
+execution path (``runner.run_spmd`` and the gpt2 parallel tiers): one
+implementation of prefetch, SIGTERM preemption drain, divergence
+guard + older-checkpoint backoff, the profile trace window, periodic
+eval, and checkpoint cadence — so the recovery story (RECOVERY.md)
+applies to the longest-lived runs (the 3-D/EP tiers on pods), not just
+the DP path (round-2 verdict item 4).
 """
 
 from __future__ import annotations
@@ -13,8 +21,244 @@ from typing import Any, Callable, Iterator
 import jax
 
 from mpit_tpu.data.loader import Prefetcher
+from mpit_tpu.train.guard import Diverged, DivergenceGuard
 from mpit_tpu.train.metrics import MetricLogger, Throughput
 from mpit_tpu.train.step import TrainState
+
+
+def hardened_loop(
+    world,
+    state: Any,
+    step_fn: Callable,
+    batches: Iterator,
+    *,
+    steps: int,
+    transform: Callable | None = None,
+    axis: str = "data",
+    items_per_batch: int | None = None,
+    log_every: int = 50,
+    logger: MetricLogger | None = None,
+    ckpt=None,
+    ckpt_every: int = 0,
+    specs: Callable | None = None,
+    max_restores: int = 1,
+    spike_factor: float = 0.0,
+    profile_dir: str = "",
+    final_save: bool = False,
+    eval_every: int = 0,
+    eval_hook: Callable | None = None,
+    dispatch_fence: int = 32,
+) -> dict:
+    """Drive ``step_fn`` from ``state`` to ``steps`` with full hardening.
+
+    Args:
+      state: initial (possibly checkpoint-restored) state; ``state.step``
+        is the authoritative resume point.
+      step_fn: jitted ``(state, device_batch) -> (state, metrics)``;
+        ``metrics`` must contain ``"loss"``.
+      batches: host-side batch iterator, already fast-forwarded past
+        ``int(state.step)`` consumed batches (seek-based resume is the
+        caller's job — it owns the dataset).
+      transform: host batch → device batch (slicing + ``shard_batch``
+        with the tier's PartitionSpecs). Default: shard the leading dim
+        over ``axis``. Runs on the prefetch thread, overlapping compute.
+      ckpt / ckpt_every / specs: CheckpointManager, save cadence, and a
+        zero-arg callable returning the state's PartitionSpecs (needed
+        for divergence restore).
+      max_restores / spike_factor: divergence policy (train/guard.py) —
+        non-finite or spiking loss restores the newest checkpoint OLDER
+        than the previous restore target, up to ``max_restores`` times.
+      profile_dir: capture a ``jax.profiler`` trace of steps 2..5 of
+        this run (clamped into range).
+      final_save: checkpoint at the natural end of the run too (the
+        tier paths' contract; run_spmd relies on cadence only).
+      eval_every / eval_hook: every N steps (and at the last step) call
+        ``eval_hook(state) -> dict`` and log it under ``eval_*`` keys —
+        the periodic full-val-split sweep hangs off this.
+      dispatch_fence: host-fetch the loss at least every N steps even
+        between log points, bounding async-dispatch depth. Two reasons:
+        the fake-CPU-mesh backend's in-process collectives starve their
+        rendezvous when ~60 collective programs are enqueued unfetched
+        ("Expected 8 threads to join" aborts — observed at 1 host core),
+        and an unbounded host-ahead window makes preemption drain and
+        divergence detection arbitrarily stale. Cost on the tunneled TPU:
+        one ~12 ms fetch per N steps — noise at N=32.
+
+    Returns ``{"state", "losses", "restores", "preempted", "steps",
+    "eval"}`` (``eval``: the last eval_hook result, or absent).
+    """
+    logger = logger or MetricLogger()
+    meter = Throughput()
+    start_step = int(state.step)
+    items = items_per_batch
+
+    prof_window = None
+    if profile_dir and steps > start_step:
+        last = steps - 1
+        prof_window = (min(start_step + 2, last), min(start_step + 5, last))
+
+    # Failure detection (SURVEY.md §6): a non-finite/spiking loss at a
+    # checked step triggers a restore (when checkpoints exist) and the run
+    # continues — up to max_restores times. Checks run at BOTH log and
+    # save points, so a checkpoint is never written on a failing loss.
+    # (Residual window: loss at step t certifies the params *entering* t,
+    # so the state saved at t could in principle already be poisoned while
+    # loss_t is finite — which is why repeat divergence steps back to an
+    # OLDER checkpoint instead of reloading the same one.) After a restore
+    # the stream keeps its position: an interrupted data order is part of
+    # divergence recovery; exact replay is only for clean resume.
+    guard_ = DivergenceGuard(spike_factor=spike_factor)
+    restores = 0
+    restore_before: int | None = None  # ceiling for the next restore target
+
+    # Preemption drain (SURVEY.md §6 recovery row; RECOVERY.md): pod
+    # maintenance/eviction delivers SIGTERM with a grace window. Catch it,
+    # finish the in-flight step, write a final checkpoint, and exit
+    # cleanly so the rescheduled job resumes from it.
+    preempted = {"flag": False}
+
+    def _on_term(signum, frame):
+        del signum, frame
+        preempted["flag"] = True
+
+    prev_handler = None
+    handler_installed = False
+    try:
+        import signal
+
+        prev_handler = signal.signal(signal.SIGTERM, _on_term)
+        handler_installed = True
+    except ValueError:
+        pass  # not the main thread (tests, embedded use): no handler
+
+    loss_trace: list[tuple[int, float]] = []
+    last_eval: dict | None = None
+    tracing = False
+    trace_done = False
+    step = start_step
+    try:
+        with Prefetcher(world, batches, axis=axis, transform=transform) as stream:
+            for batch in stream:
+                if step >= steps:
+                    break
+                if preempted["flag"]:
+                    if ckpt:
+                        if ckpt.latest_step() != step:  # cadence saved it
+                            ckpt.save(step, state)
+                        ckpt.wait()
+                    logger.log(
+                        step,
+                        {"event": "preempted_checkpoint_and_exit",
+                         "resumable": bool(ckpt)},
+                    )
+                    break
+                if (
+                    prof_window
+                    and not tracing
+                    and not trace_done
+                    and step == prof_window[0]
+                ):
+                    jax.profiler.start_trace(profile_dir)
+                    tracing = True
+                state, metrics = step_fn(state, batch)
+                if tracing and step >= prof_window[1]:
+                    float(metrics["loss"])  # host fetch: trace covers real work
+                    jax.profiler.stop_trace()
+                    tracing = False
+                    trace_done = True
+                rate = meter.tick(items) if items else None
+                should_log = (step + 1) % log_every == 0 or step + 1 == steps
+                should_save = bool(
+                    ckpt and ckpt_every and (step + 1) % ckpt_every == 0
+                )
+                should_eval = bool(
+                    eval_hook
+                    and eval_every
+                    and ((step + 1) % eval_every == 0 or step + 1 == steps)
+                )
+                if not (should_log or should_save) and (
+                    dispatch_fence and (step + 1) % dispatch_fence == 0
+                ):
+                    float(metrics["loss"])  # bound async-dispatch depth
+                if should_log or should_save:
+                    loss = float(metrics["loss"])
+                    try:
+                        guard_.check(step + 1, loss)
+                    except Diverged:
+                        candidates = [
+                            s
+                            for s in (ckpt.all_steps() if ckpt else [])
+                            if restore_before is None or s < restore_before
+                        ]
+                        if not candidates or restores >= max_restores:
+                            raise
+                        target = max(candidates)
+                        restores += 1
+                        state = ckpt.restore(state, specs(), step=target)
+                        step = int(state.step)
+                        restore_before = target
+                        guard_.reset()
+                        loss_trace = [(s, l) for s, l in loss_trace if s <= step]
+                        logger.log(
+                            step,
+                            {"event": "restored_after_divergence",
+                             "bad_loss": loss, "restores": restores},
+                        )
+                        continue
+                    if should_log:
+                        loss_trace.append((step + 1, loss))
+                        out = {k: float(v) for k, v in metrics.items()}
+                        if rate is not None:
+                            out["items_per_sec"] = rate
+                        logger.log(step + 1, out)
+                    if should_save:
+                        ckpt.save(step + 1, state)
+                        # A new guard-passing checkpoint supersedes the
+                        # poisoned-latest suspicion from a past restore.
+                        restore_before = None
+                if should_eval:
+                    last_eval = eval_hook(state)
+                    if last_eval:
+                        logger.log(
+                            step + 1,
+                            {"eval_" + k: v for k, v in last_eval.items()},
+                        )
+                step += 1
+    finally:
+        if tracing:  # run ended (or raised) inside the window
+            jax.profiler.stop_trace()
+        if handler_installed:
+            # Restore unconditionally (getsignal-None priors included —
+            # prev_handler None means "installed outside Python", and
+            # SIG_DFL is the closest restorable equivalent).
+            import signal
+
+            signal.signal(
+                signal.SIGTERM,
+                prev_handler if prev_handler is not None else signal.SIG_DFL,
+            )
+    if ckpt:
+        if (
+            final_save
+            and not preempted["flag"]
+            and step > start_step
+            and ckpt.latest_step() != step  # cadence already saved here
+        ):
+            ckpt.save(step, state)
+        ckpt.wait()
+
+    losses = [l for _, l in loss_trace]
+    out = {
+        "state": state,
+        "steps": int(state.step),
+        "losses": losses,
+        "final_loss": losses[-1] if losses else float("nan"),
+        "restores": restores,
+        "preempted": preempted["flag"],
+    }
+    if last_eval:  # an empty sweep (val split < one batch) records nothing
+        out["eval"] = last_eval
+    return out
 
 
 class Trainer:

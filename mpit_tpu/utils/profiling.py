@@ -121,12 +121,20 @@ def compiled_cost(fn: Callable, *args, **kwargs) -> dict[str, float]:
 
 @dataclasses.dataclass(frozen=True)
 class ChipSpec:
-    """Peak numbers for a roofline. Defaults: TPU v5e (public figures)."""
+    """Peak numbers for a roofline. Defaults: TPU v5e (public figures).
+
+    ``dcn_bandwidth`` is the per-chip cross-slice (data-center network)
+    bandwidth — v5e hosts expose ~100 Gbps NICs shared by 4 chips, i.e.
+    ~3.1 GB/s/chip (public order-of-magnitude; the "How to Scale Your
+    Model" planning figure). It is an ASSUMPTION for modeled multi-slice
+    numbers and is labeled as such wherever it is used.
+    """
 
     name: str = "tpu-v5e"
     peak_flops_bf16: float = 197e12  # FLOP/s
     hbm_bandwidth: float = 819e9  # bytes/s
     ici_bandwidth: float = 4.5e10  # bytes/s per link direction (3 links/chip)
+    dcn_bandwidth: float = 3.1e9  # bytes/s per chip across slices (assumed)
 
 
 TPU_V5E = ChipSpec()
@@ -207,27 +215,87 @@ def allreduce_gbps(
 class CommModel:
     """Per-step communication accounting for a training config.
 
-    Static model of what the SPMD step moves over ICI — gradients
-    (allreduce, or reduce-scatter + all-gather under ZeRO-1) — so logs can
-    report comm-bytes alongside measured step time (SURVEY.md §6
+    Static model of what the SPMD step moves for gradient sync
+    (allreduce, or reduce-scatter + all-gather under ZeRO-1) — so logs
+    can report comm-bytes alongside measured step time (SURVEY.md §6
     metrics row).
+
+    DCN awareness (SURVEY.md §3.4 transport: "ICI (intra-slice) and DCN
+    (cross-slice)"): when ``num_slices > 1``, the data axis is laid out
+    slice-major (``comm.init_hybrid``) and the allreduce decomposes
+    hierarchically — intra-slice reduce-scatter/all-gather over ICI on
+    ``num_devices / num_slices`` chips, plus a cross-slice phase over DCN
+    on the slice-sharded 1/c fraction of the gradient. The phases are
+    modeled separately so the DCN cliff is visible in scaling
+    projections.
     """
 
-    def __init__(self, params, num_devices: int, *, zero1: bool = True):
+    def __init__(
+        self,
+        params,
+        num_devices: int,
+        *,
+        zero1: bool = True,
+        num_slices: int = 1,
+    ):
+        if num_slices > 1 and num_devices % num_slices:
+            raise ValueError(
+                f"{num_devices} devices not divisible into {num_slices} slices"
+            )
         self.param_bytes = tree_bytes(params)
         self.num_devices = num_devices
         self.zero1 = zero1
+        self.num_slices = num_slices if num_devices > 1 else 1
+
+    def _phase_bytes(self, payload: float, p: int) -> float:
+        """Per-chip wire bytes to allreduce ``payload`` over ``p`` ranks
+        (2·(P−1)/P·N: ZeRO-1's RS+AG and the plain allreduce move the
+        same total — they differ in where the optimizer runs, not in
+        bytes)."""
+        return collective_bytes(payload, p, "allreduce")
 
     def grad_sync_bytes(self) -> float:
-        if self.zero1:
-            return collective_bytes(
-                self.param_bytes, self.num_devices, "reduce_scatter"
-            ) + collective_bytes(self.param_bytes, self.num_devices, "all_gather")
-        return collective_bytes(self.param_bytes, self.num_devices, "allreduce")
+        """Total per-chip wire bytes (both phases; ICI + DCN)."""
+        ici, dcn = self.grad_sync_bytes_by_tier()
+        return ici + dcn
+
+    def grad_sync_bytes_by_tier(self) -> tuple[float, float]:
+        """Per-chip wire bytes split into (ICI, DCN) phases."""
+        if self.num_devices <= 1:
+            return 0.0, 0.0
+        s = self.num_slices
+        if s <= 1:
+            return self._phase_bytes(self.param_bytes, self.num_devices), 0.0
+        per_slice = self.num_devices // s
+        intra = self._phase_bytes(self.param_bytes, per_slice)
+        # Cross-slice phase: each of the per_slice shard groups allreduces
+        # its 1/per_slice fraction across the s slice peers, over DCN.
+        inter = self._phase_bytes(self.param_bytes / per_slice, s)
+        return intra, inter
+
+    def grad_sync_seconds(self, chip: ChipSpec = TPU_V5E) -> dict[str, float]:
+        """Modeled time for the gradient sync (phases serialized —
+        conservative; overlap assumptions belong to the caller and must
+        be labeled)."""
+        ici_b, dcn_b = self.grad_sync_bytes_by_tier()
+        t_ici = ici_b / chip.ici_bandwidth
+        t_dcn = dcn_b / chip.dcn_bandwidth
+        return {
+            "ici_s": t_ici,
+            "dcn_s": t_dcn,
+            "total_s": t_ici + t_dcn,
+            "modeled": True,
+        }
 
     def summary(self) -> dict[str, float]:
-        return {
+        ici_b, dcn_b = self.grad_sync_bytes_by_tier()
+        out = {
             "param_bytes": float(self.param_bytes),
-            "grad_sync_bytes_per_step": self.grad_sync_bytes(),
+            "grad_sync_bytes_per_step": ici_b + dcn_b,
             "num_devices": self.num_devices,
         }
+        if self.num_slices > 1:
+            out["grad_sync_ici_bytes"] = ici_b
+            out["grad_sync_dcn_bytes"] = dcn_b
+            out["num_slices"] = self.num_slices
+        return out

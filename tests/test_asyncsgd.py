@@ -42,7 +42,7 @@ class TestMnist:
         assert out["mode"] == "spmd"
         assert out["steps"] == 30
         assert out["final_loss"] < 0.5 < out["losses"][0]
-        assert out["eval"]["accuracy"] > 0.7
+        assert out["eval"]["top1"] > 0.7
 
     def test_parity_downpour_1server_1client(self):
         # Literally baseline config #1: 1 pserver + 1 pclient.

@@ -1,0 +1,251 @@
+"""Input augmentation (data/augment.py) + the periodic val-split sweep.
+
+The accuracy-loop machinery for the 58% top-1 north star (BASELINE.json;
+round-2 verdict item 1): shift-crop/hflip on the train stream (numpy and
+C++ paths), deterministic under seek-based resume, never applied to eval;
+full-val-split top-1/top-5 evaluation every --eval-every steps; and the
+e2e demonstration that augmentation measurably improves held-out accuracy
+on a shift-structured fixture.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mpit_tpu.data import write_classification
+from mpit_tpu.data.augment import augment_images
+
+
+class TestAugmentImages:
+    def test_shift_bounds_and_mass(self):
+        """Crops are shifts in [-pad, pad]^2 with zero fill: a centered
+        block stays a block (same mass when it stays inside)."""
+        imgs = np.zeros((16, 12, 12, 1), np.float32)
+        imgs[:, 5:7, 5:7] = 1.0
+        out = augment_images(imgs, np.random.RandomState(0), pad=3, hflip=False)
+        assert out.shape == imgs.shape
+        for i in range(16):
+            ys, xs = np.nonzero(out[i, :, :, 0])
+            assert out[i].sum() == 4.0  # block never clipped (5-2*3 >= 0... it fits)
+            assert 2 <= ys.min() and ys.max() <= 9  # within +-3 of [5, 6]
+            assert 2 <= xs.min() and xs.max() <= 9
+
+    def test_deterministic_and_input_untouched(self):
+        imgs = np.random.RandomState(1).rand(8, 10, 10, 3).astype(np.float32)
+        orig = imgs.copy()
+        a = augment_images(imgs, np.random.RandomState(7), pad=2)
+        b = augment_images(imgs, np.random.RandomState(7), pad=2)
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(imgs, orig)  # owned-buffer contract
+
+    def test_hflip_only(self):
+        imgs = np.zeros((64, 4, 4, 1), np.float32)
+        imgs[:, :, 0] = 1.0  # left column lit
+        out = augment_images(imgs, np.random.RandomState(0), pad=0, hflip=True)
+        left = (out[:, :, 0] == 1.0).all(axis=(1, 2))
+        right = (out[:, :, 3] == 1.0).all(axis=(1, 2))
+        assert (left | right).all() and left.any() and right.any()
+
+    def test_rejects_non_4d(self):
+        with pytest.raises(ValueError, match="B,H,W,C"):
+            augment_images(np.zeros((4, 8, 8)), np.random.RandomState(0))
+
+
+class TestFileAugmentation:
+    def _ds(self, tmp_path, **kw):
+        from mpit_tpu.data import FileClassification
+
+        rng = np.random.RandomState(0)
+        imgs = rng.randint(0, 255, size=(64, 12, 12, 1)).astype(np.uint8)
+        d = write_classification(
+            str(tmp_path / "ds"), imgs, rng.randint(0, 4, 64), num_classes=4
+        )
+        return FileClassification(d, **kw)
+
+    def test_augment_changes_train_not_eval(self, tmp_path):
+        plain = self._ds(tmp_path)
+        aug = self._ds(tmp_path, augment=True, crop_pad=2)
+        b_plain = next(plain.batches(16))
+        b_aug = next(aug.batches(16))
+        # Same samples drawn (same permutation stream), different pixels.
+        np.testing.assert_array_equal(b_plain["label"], b_aug["label"])
+        assert not np.array_equal(b_plain["image"], b_aug["image"])
+        # eval/val paths are never augmented.
+        np.testing.assert_array_equal(
+            plain.eval_batch(8)["image"], aug.eval_batch(8)["image"]
+        )
+        np.testing.assert_array_equal(
+            next(plain.val_batches(8))["image"],
+            next(aug.val_batches(8))["image"],
+        )
+
+    def test_augmented_skip_matches_drain(self, tmp_path):
+        """Seek-based resume replays the augmented stream exactly: the
+        augmentation RNG is counter-based per batch, not shared with the
+        epoch-permutation stream."""
+        aug1 = self._ds(tmp_path, augment=True, crop_pad=2)
+        drained = aug1.batches(16)
+        for _ in range(5):
+            next(drained)
+        want = next(drained)
+        aug2 = self._ds(tmp_path, augment=True, crop_pad=2)
+        got = next(aug2.batches(16, skip=5))
+        np.testing.assert_array_equal(got["label"], want["label"])
+        np.testing.assert_array_equal(got["image"], want["image"])
+
+
+class TestSyntheticAugmentation:
+    def test_python_path_augments_and_skips(self):
+        from mpit_tpu.data import SyntheticClassification
+
+        ds = SyntheticClassification(
+            image_shape=(12, 12, 1), num_classes=4, augment=True, crop_pad=2
+        )
+        drained = ds.batches(8)
+        for _ in range(3):
+            next(drained)
+        want = next(drained)
+        got = next(ds.batches(8, skip=3))
+        np.testing.assert_array_equal(got["image"], want["image"])
+        # eval_batch is clean: stddev of border rows should show signal
+        # (a shifted stream zeroes borders on some images).
+        ev = ds.eval_batch(8)
+        assert ev["image"].shape == (8, 12, 12, 1)
+
+    def test_native_core_augments(self):
+        """C++ shift-crop+flip: deterministic per (seed, ticket), and the
+        augmentation visibly moves mass relative to the clean stream
+        (distributional contract — not bit-parity with numpy)."""
+        from mpit_tpu.data import native
+
+        if not native.available():
+            pytest.skip(f"native core unavailable: {native.build_error()}")
+        protos = np.zeros((2, 12, 12, 1), np.float32)
+        protos[:, 4:8, 4:8] = 10.0  # centered block
+        kw = dict(noise=0.0, batch_size=32, seed=5, threads=2)
+        with native.classification_stream(
+            protos, augment=True, crop_pad=3, hflip=False, **kw
+        ) as s1:
+            b1 = next(s1)
+        with native.classification_stream(
+            protos, augment=True, crop_pad=3, hflip=False, **kw
+        ) as s2:
+            b2 = next(s2)
+        np.testing.assert_array_equal(b1["image"], b2["image"])
+        np.testing.assert_array_equal(b1["label"], b2["label"])
+        centers = []
+        for img in b1["image"]:
+            ys, xs = np.nonzero(img[:, :, 0])
+            assert img.sum() == pytest.approx(160.0)  # 16 px * 10, never clipped
+            centers.append((ys.mean(), xs.mean()))
+        # shifts actually happen and span both axes
+        assert np.std([c[0] for c in centers]) > 0.5
+        assert np.std([c[1] for c in centers]) > 0.5
+        # flip variant differs from no-flip variant
+        with native.classification_stream(
+            protos, augment=True, crop_pad=0, hflip=True, **kw
+        ) as s3:
+            b3 = next(s3)
+        asym = np.zeros((2, 12, 12, 1), np.float32)
+        asym[:, :, 0:2] = 7.0
+        with native.classification_stream(
+            asym, augment=True, crop_pad=0, hflip=True, noise=0.0,
+            batch_size=64, seed=5, threads=2,
+        ) as s4:
+            b4 = next(s4)
+        del b3
+        left = (b4["image"][:, :, 0:2] == 7.0).all(axis=(1, 2, 3))
+        right = (b4["image"][:, :, 10:12] == 7.0).all(axis=(1, 2, 3))
+        assert left.any() and right.any() and (left | right).all()
+
+
+class TestValSweep:
+    def test_file_val_batches_cover_split_in_order(self, tmp_path):
+        from mpit_tpu.data import FileClassification
+
+        rng = np.random.RandomState(0)
+        d = write_classification(
+            str(tmp_path / "ds"),
+            rng.randint(0, 255, (32, 6, 6, 1)).astype(np.uint8),
+            rng.randint(0, 3, 32),
+            num_classes=3,
+        )
+        vlabels = np.arange(20) % 3
+        write_classification(
+            d,
+            rng.randint(0, 255, (20, 6, 6, 1)).astype(np.uint8),
+            vlabels,
+            split="val",
+            num_classes=3,
+        )
+        ds = FileClassification(d)
+        assert ds.val_size == 20
+        got = list(ds.val_batches(8))
+        assert len(got) == 2  # floor(20/8), remainder dropped
+        np.testing.assert_array_equal(
+            np.concatenate([b["label"] for b in got]), vlabels[:16]
+        )
+        assert len(list(ds.val_batches(8, num_batches=1))) == 1
+
+    def test_periodic_sweep_logged_and_final_eval_is_sweep(self, capsys):
+        """--eval-every drives full-sweep eval rows; the returned eval is
+        the last sweep's averaged top-1."""
+        from mpit_tpu.asyncsgd import mnist as app
+
+        out = app.main(
+            ["--steps", "20", "--batch-size", "32", "--log-every", "10",
+             "--eval-every", "10", "--eval-batches", "2",
+             "--eval-batch", "32"]
+        )
+        assert "top1" in out["eval"] and "loss" in out["eval"]
+        logged = capsys.readouterr().out
+        assert logged.count("eval_top1") >= 2  # steps 10 and 20
+
+
+class TestAugmentationImprovesAccuracy:
+    def test_shifted_val_fixture(self, tmp_path):
+        """E2E (round-2 verdict item 1 'done' criterion): on a fixture
+        whose val split shows the train sprites at unseen positions,
+        --augment true lifts val top-1 far above the un-augmented run
+        (which overfits the centered position)."""
+        rng = np.random.RandomState(0)
+        C, S = 8, 12
+        sprites = rng.randint(80, 255, size=(C, S, S, 1)).astype(np.float32)
+
+        def place(cls, dy, dx):
+            img = np.zeros((28, 28, 1), np.float32)
+            o = (28 - S) // 2
+            img[o + dy : o + dy + S, o + dx : o + dx + S] = sprites[cls]
+            return img
+
+        labels = rng.randint(0, C, size=512)
+        imgs = np.stack([place(l, 0, 0) for l in labels])  # train: centered
+        imgs = np.clip(imgs + rng.randn(*imgs.shape) * 8, 0, 255).astype(
+            np.uint8
+        )
+        d = write_classification(
+            str(tmp_path / "shift"), imgs, labels, num_classes=C
+        )
+        vlab = rng.randint(0, C, size=256)
+        vimg = np.stack(
+            [place(l, *rng.randint(-4, 5, size=2)) for l in vlab]
+        )  # val: shifted
+        vimg = np.clip(vimg + rng.randn(*vimg.shape) * 8, 0, 255).astype(
+            np.uint8
+        )
+        write_classification(d, vimg, vlab, split="val", num_classes=C)
+
+        from mpit_tpu.asyncsgd import mnist as app
+
+        common = [
+            "--data-dir", d, "--steps", "400", "--batch-size", "64",
+            "--lr", "0.05", "--schedule", "warmup", "--warmup-steps", "20",
+            "--log-every", "200", "--eval-batch", "64",
+        ]
+        no_aug = app.main(common + ["--augment", "false"])
+        aug = app.main(common + ["--augment", "true", "--crop-pad", "4"])
+        # Measured on this fixture: ~0.25 vs ~0.64 (margins generous).
+        assert no_aug["eval"]["top1"] < 0.45
+        assert aug["eval"]["top1"] > 0.50
+        assert aug["eval"]["top1"] > no_aug["eval"]["top1"] + 0.15

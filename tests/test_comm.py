@@ -42,6 +42,44 @@ class TestInit:
         assert isinstance(w, comm.World)
 
 
+class TestInitHybrid:
+    """DCN-aware multi-slice worlds (SURVEY.md §3.4 transport row):
+    virtual slices on the fake CPU mesh exercise the exact layout math
+    real multi-slice pods use."""
+
+    def test_slice_major_data_axis(self):
+        w = comm.init_hybrid(
+            {"data": 4, "model": 2}, {"data": 2}, set_default=False
+        )
+        assert w.shape == {"data": 4, "model": 2}
+        assert w.dcn_factor("data") == 2
+        assert w.dcn_factor("model") == 1
+        assert w.num_slices == 2
+        ids = np.vectorize(lambda d: d.id)(w.mesh.devices)
+        # 8 devices, 2 virtual slices of 4 (contiguous fallback): data
+        # coordinates 0-1 must live in slice 0 (ids 0-3), 2-3 in slice 1.
+        assert set(ids[:2].ravel()) == {0, 1, 2, 3}
+        assert set(ids[2:].ravel()) == {4, 5, 6, 7}
+        # model axis stays inside a slice on every data row
+        for row in ids:
+            assert abs(int(row[0]) - int(row[1])) <= 3
+
+    def test_collective_runs_on_hybrid_mesh(self):
+        w = comm.init_hybrid({"data": 8}, {"data": 4}, set_default=False)
+        got = w.allreduce(np.ones((8, 2), np.float32))
+        np.testing.assert_allclose(np.asarray(got), 8 * np.ones((1, 2)))
+
+    def test_rejects_bad_factorization(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            comm.init_hybrid({"data": 8}, {"data": 3}, set_default=False)
+        with pytest.raises(ValueError, match="unknown mesh axes"):
+            comm.init_hybrid({"data": 8}, {"pipe": 2}, set_default=False)
+
+    def test_pure_ici_degenerates_to_flat(self):
+        w = comm.init_hybrid({"data": 8}, {}, set_default=False)
+        assert w.num_slices == 1 and w.dcn_axes is None
+
+
 class TestCollectives:
     def test_rank_size(self, world8):
         n = world8.num_devices

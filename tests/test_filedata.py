@@ -131,7 +131,7 @@ class TestWorkloadIntegration:
             ["--data-dir", d, "--steps", "80", "--batch-size", "64",
              "--lr", "0.05", "--log-every", "40", "--eval-batch", "64"]
         )
-        assert out["eval"]["accuracy"] > 0.9
+        assert out["eval"]["top1"] > 0.9
 
     def test_mnist_app_rejects_wrong_geometry(self, tmp_path):
         d, _, _ = _cls_fixture(tmp_path)  # 8x8 images

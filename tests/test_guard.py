@@ -142,3 +142,58 @@ class TestPreemptionDrain:
         )
         assert out2["steps"] == res["steps"] + 5
         assert out2["preempted"] is False
+
+    def test_sigterm_drains_ep_tier_run(self, tmp_path):
+        """The hand-driven tier loops share run_spmd's hardening
+        (train/loop.hardened_loop; round-2 verdict item 4): a real
+        SIGTERM against an EP-tier training subprocess drains to a
+        checkpoint, and the run resumes from it."""
+        import json
+        import os
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        ck = str(tmp_path / "ck")
+        flags = [
+            "--steps", "100000", "--batch-size", "8", "--seq-len", "32",
+            "--num-layers", "2", "--num-heads", "2", "--d-model", "32",
+            "--vocab-size", "128", "--mesh", "data=2,expert=4",
+            "--moe-experts", "4", "--log-every", "5", "--ckpt-every", "5",
+            "--ckpt-dir", ck,
+        ]
+        code = (
+            "from mpit_tpu.asyncsgd import gpt2 as app\n"
+            "import json\n"
+            f"out = app.main({flags!r})\n"
+            "print('RESULT ' + json.dumps({'steps': out['steps'],\n"
+            "    'preempted': out['preempted'], 'tier': out['tier']}))\n"
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=dict(os.environ),
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        time.sleep(90)  # compile (MoE tier) + some steps
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=300)
+        assert proc.returncode == 0, out[-2000:]
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert line, out[-2000:]
+        res = json.loads(line[-1][len("RESULT "):])
+        assert res["preempted"] is True
+        assert res["tier"].startswith("ep-")
+        assert 0 < res["steps"] < 100000
+        assert os.path.isdir(ck), "no checkpoint written on preemption"
+
+        from mpit_tpu.asyncsgd import gpt2 as app
+
+        out2 = app.main(
+            flags[:1] + [str(res["steps"] + 3)] + flags[2:]
+        )
+        assert out2["steps"] == res["steps"] + 3
+        assert out2["preempted"] is False

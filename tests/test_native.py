@@ -111,7 +111,7 @@ class TestIntegration:
              "--native", "true"]
         )
         assert out["final_loss"] < 1.0
-        assert out["eval"]["accuracy"] > 0.6
+        assert out["eval"]["top1"] > 0.6
 
     def test_fallback_when_disabled(self, monkeypatch):
         monkeypatch.setenv("MPIT_NATIVE", "0")

@@ -93,6 +93,36 @@ class TestCollectiveModel:
     def test_allreduce_gbps(self):
         assert allreduce_gbps(8e9, 8, 2.0) == 4.0
 
+    def test_hierarchical_dcn_phases(self):
+        """Multi-slice grad sync decomposes into ICI + DCN phases; the
+        DCN phase moves 1/per_slice of the payload across the slice
+        count, and dominates the modeled time at DCN bandwidth."""
+        params = {"w": jnp.ones((1 << 20,), jnp.float32)}  # 4 MiB
+        n, slices = 256, 4
+        m = CommModel(params, n, num_slices=slices)
+        ici_b, dcn_b = m.grad_sync_bytes_by_tier()
+        nbytes = 4 * (1 << 20)
+        per_slice = n // slices
+        np.testing.assert_allclose(
+            ici_b, 2 * (per_slice - 1) / per_slice * nbytes
+        )
+        np.testing.assert_allclose(
+            dcn_b, 2 * (slices - 1) / slices * nbytes / per_slice
+        )
+        t = m.grad_sync_seconds()
+        assert t["modeled"] is True
+        # DCN moves ~64x fewer bytes but is ~15x slower per byte: the
+        # phases are within an order of magnitude — the cliff the flat
+        # model hides entirely.
+        assert t["dcn_s"] > 0 and t["ici_s"] > 0
+        flat = CommModel(params, n)
+        assert flat.grad_sync_bytes_by_tier()[1] == 0.0
+        summ = m.summary()
+        assert summ["num_slices"] == slices
+        np.testing.assert_allclose(
+            summ["grad_sync_bytes_per_step"], ici_b + dcn_b
+        )
+
 
 class TestTraceIntegration:
     def test_app_profile_dir_writes_trace(self, tmp_path):
