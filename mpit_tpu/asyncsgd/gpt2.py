@@ -43,7 +43,10 @@ class GPT2TrainConfig(TrainConfig):
     flash: bool = False  # Pallas flash-attention inner kernel (TPU)
     ulysses: bool = False  # cp tier: all-to-all Ulysses instead of the ring
     microbatches: int = 4  # pp tier: microbatch count
-    pp_schedule: str = "gpipe"  # pp tier: "gpipe" (AD oracle) | "1f1b"
+    # pp tier schedule: "gpipe" (AD oracle) | "1f1b" | "interleaved"
+    # (virtual stages: pp_chunks model chunks per pipe device)
+    pp_schedule: str = "gpipe"
+    pp_chunks: int = 2
     # ep tier (--mesh data=..,expert=..): routed-MoE MLPs (parallel.ep)
     moe_experts: int = 8
     moe_k: int = 2
@@ -241,11 +244,10 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
                 "gpt2: the dp-tp-pp tier composes exactly data, model and "
                 "pipe axes (--mesh data=..,model=..,pipe=..)"
             )
-        if cfg.flash or cfg.ulysses:
+        if cfg.ulysses:
             raise SystemExit(
-                "gpt2: --flash/--ulysses are not supported on the 3-D "
-                "tiers (the Megatron block uses XLA attention; ring "
-                "attention only on the seq-axis tier)"
+                "gpt2: --ulysses needs a seq axis (use the dp-cp-tp tier, "
+                "--mesh data=..,seq=..,model=..)"
             )
         if "data" not in mesh_shape:
             mesh_shape = {"data": 1, **mesh_shape}
@@ -260,7 +262,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         m3 = GPT2(mcfg_3d)
         init_fn, step_fn, specs_fn = make_gpt2_dp_tp_pp_train_step(
             mcfg_3d, tx, world, num_microbatches=cfg.microbatches,
-            zero1=cfg.zero1,
+            zero1=cfg.zero1, flash=cfg.flash,
         )
 
         def d3_init():
@@ -282,7 +284,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             ),
             specs_fn,
         )
-        tier = "3d-dp-tp-pp"
+        tier = "3d-dp-tp-pp" + ("-flash" if cfg.flash else "")
     elif mesh_shape and "pipe" in mesh_shape:
         # Pipeline-parallel tier (parallel.pp): blocks split into stages
         # over the pipe axis, GPipe microbatch ring, untied LM head.
@@ -294,7 +296,11 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         if "data" not in mesh_shape:
             mesh_shape = {"data": 1, **mesh_shape}
         from mpit_tpu.data import shard_batch
-        from mpit_tpu.parallel import make_gpt2_pp_train_step, split_gpt2_params
+        from mpit_tpu.parallel import (
+            make_gpt2_pp_train_step,
+            split_gpt2_params,
+            split_gpt2_params_interleaved,
+        )
 
         world = mpit_tpu.init(mesh_shape)
         n_pipe = world.axis_size("pipe")
@@ -303,6 +309,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         init_fn, step_fn, specs_fn = make_gpt2_pp_train_step(
             mcfg_pp, tx, world, num_microbatches=cfg.microbatches,
             zero1=cfg.zero1, schedule=cfg.pp_schedule,
+            num_chunks=cfg.pp_chunks,
         )
 
         def pp_init():
@@ -310,6 +317,13 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             full = jax.jit(pp_model.init)(jax.random.key(cfg.seed), tokens)[
                 "params"
             ]
+            if cfg.pp_schedule == "interleaved":
+                return (
+                    split_gpt2_params_interleaved(
+                        full, mcfg_pp.num_layers, n_pipe, cfg.pp_chunks
+                    ),
+                    (),
+                )
             return split_gpt2_params(full, mcfg_pp.num_layers, n_pipe), ()
 
         init_params = pp_init  # noqa: F811 — pp uses the split layout
@@ -320,7 +334,9 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             ),
             specs_fn,
         )
-        tier = f"pp-{cfg.pp_schedule}-m{cfg.microbatches}"
+        tier = f"pp-{cfg.pp_schedule}-m{cfg.microbatches}" + (
+            f"-v{cfg.pp_chunks}" if cfg.pp_schedule == "interleaved" else ""
+        )
     elif mesh_shape and "seq" in mesh_shape and "model" in mesh_shape:
         # 3-D tier (parallel.threed): ring attention INSIDE the Megatron
         # block — data x seq x model (TP inside CP).
@@ -328,12 +344,6 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             raise SystemExit(
                 "gpt2: the dp-cp-tp tier composes exactly data, seq and "
                 "model axes (--mesh data=..,seq=..,model=..)"
-            )
-        if cfg.flash or cfg.ulysses:
-            raise SystemExit(
-                "gpt2: --flash/--ulysses are not supported on the 3-D "
-                "tiers (the dp-cp-tp tier hardcodes the XLA K/V ring; "
-                "use --mesh data=..,seq=.. for the flash/ulysses options)"
             )
         if "data" not in mesh_shape:
             mesh_shape = {"data": 1, **mesh_shape}
@@ -347,7 +357,8 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
         world = mpit_tpu.init(mesh_shape)
         m7 = GPT2(mcfg)
         init_fn, step_fn, specs_fn = make_gpt2_dp_cp_tp_train_step(
-            mcfg, tx, world, zero1=cfg.zero1
+            mcfg, tx, world, zero1=cfg.zero1, flash=cfg.flash,
+            ulysses=cfg.ulysses,
         )
 
         def cptp_init():
@@ -370,7 +381,11 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             ),
             specs_fn,
         )
-        tier = "3d-dp-cp-tp"
+        tier = (
+            "3d-dp-cp-tp"
+            + ("-ulysses" if cfg.ulysses else "")
+            + ("-flash" if cfg.flash else "")
+        )
     elif mesh_shape and "seq" in mesh_shape:
         # Context-parallel tier: sequence sharded over the seq axis, ring
         # attention inside, cross-shard next-token targets (parallel.cp).

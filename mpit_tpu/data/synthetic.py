@@ -43,12 +43,17 @@ class SyntheticClassification:
             np.float32
         )
 
-    def _raw_batch(self, batch_size: int, base: int, idx: int):
+    def _raw_batch(self, batch_size: int, base: int, idx: int, salt: int = 0):
         """One un-augmented batch + its (partially consumed) RNG — the
         shared generator for the train stream (which may augment with
         further draws from the same RNG) and the always-clean eval/val
-        paths."""
-        rng = np.random.RandomState((base * 1_000_003 + idx) % 2**31)
+        paths. ``salt`` puts eval/val in a seed namespace no train base
+        can reach (base offsets alone are NOT disjoint: train base
+        ``seed+1`` colliding with a val base was a round-3 review
+        finding — the 'held-out' sweep would score training batches)."""
+        rng = np.random.RandomState(
+            (base * 1_000_003 + idx + salt * 715_827_883) % 2**31
+        )
         labels = rng.randint(0, self.num_classes, size=(batch_size,))
         images = self.prototypes[labels] + self.noise * rng.randn(
             batch_size, *self.image_shape
@@ -79,17 +84,19 @@ class SyntheticClassification:
             yield {"image": images, "label": labels}
 
     def eval_batch(self, batch_size: int, *, seed: int = 10_000):
-        images, labels, _ = self._raw_batch(batch_size, seed, 0)
+        images, labels, _ = self._raw_batch(batch_size, seed, 0, salt=1)
         return {"image": images, "label": labels}
 
     def val_batches(
         self, batch_size: int, *, num_batches: int | None = None
     ):
         """Finite deterministic sweep of held-out batches (the synthetic
-        stand-in for a val split; seeds disjoint from the train stream).
-        Never augmented."""
+        stand-in for a val split; the salt=1 namespace keeps them
+        disjoint from every train stream). Never augmented."""
         for i in range(num_batches if num_batches is not None else 8):
-            images, labels, _ = self._raw_batch(batch_size, 20_000 + i, 0)
+            images, labels, _ = self._raw_batch(
+                batch_size, 20_000 + i, 0, salt=1
+            )
             yield {"image": images, "label": labels}
 
     def native_batches(

@@ -37,10 +37,16 @@ from mpit_tpu.parallel.tp import (
 )
 from mpit_tpu.parallel.pipeline import (
     live_microbatch_slots,
+    interleaved_ticks,
     spmd_pipeline,
     spmd_pipeline_1f1b,
+    spmd_pipeline_interleaved_1f1b,
 )
-from mpit_tpu.parallel.pp import make_gpt2_pp_train_step, split_gpt2_params
+from mpit_tpu.parallel.pp import (
+    make_gpt2_pp_train_step,
+    split_gpt2_params,
+    split_gpt2_params_interleaved,
+)
 from mpit_tpu.parallel.megatron import (
     column_parallel_dense,
     repack_qkv,
@@ -74,6 +80,7 @@ __all__ = [
     "make_gpt2_cp_train_step",
     "make_gpt2_pp_train_step",
     "split_gpt2_params",
+    "split_gpt2_params_interleaved",
     "ring_attention",
     "ring_flash_attention",
     "ulysses_attention",
@@ -83,6 +90,8 @@ __all__ = [
     "make_pjit_train_step",
     "spmd_pipeline",
     "spmd_pipeline_1f1b",
+    "spmd_pipeline_interleaved_1f1b",
+    "interleaved_ticks",
     "live_microbatch_slots",
     "column_parallel_dense",
     "row_parallel_dense",

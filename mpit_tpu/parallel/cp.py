@@ -45,6 +45,46 @@ from mpit_tpu.parallel.ulysses import ulysses_attention
 from mpit_tpu.train.step import TrainState, zero1_state_fns
 
 
+def make_seq_attention(
+    seq_axis: str,
+    *,
+    flash: bool = False,
+    ulysses: bool = False,
+    interpret: bool | None = None,
+):
+    """Select the sequence-sharded attention implementation — the ONE
+    seam every CP-bearing tier shares (this module's step and the
+    dp x seq x model tier in ``parallel.threed``).
+
+    Returns ``(attention_fn, check_vma)``: the [B, T/P, H, D] → same
+    drop-in (ring K/V hops, the fused Pallas ring-flash kernel, or the
+    Ulysses all-to-all head↔sequence re-shard, with flash optionally as
+    Ulysses' inner kernel), plus whether the shard_map VMA checker can
+    stay on (the Pallas *interpreter* loses declared vma — known jax 0.9
+    limitation; compiled TPU keeps it on).
+    """
+    check_vma = not (flash and interpret)
+    if ulysses:
+        if flash:
+            from mpit_tpu.ops import flash_attention
+
+            inner = partial(flash_attention, interpret=interpret)
+        else:
+            from mpit_tpu.ops import reference_attention as inner
+        attn = partial(ulysses_attention, axis=seq_axis, inner=inner)
+    elif flash:
+        attn = partial(
+            ring_flash_attention, axis=seq_axis, interpret=interpret
+        )
+    else:
+        attn = partial(ring_attention, axis=seq_axis)
+
+    def attention_fn(q, k, v, *, causal=True):
+        return attn(q, k, v, causal=causal)
+
+    return attention_fn, check_vma
+
+
 def make_gpt2_cp_train_step(
     cfg: GPT2Config,
     tx: optax.GradientTransformation,
@@ -78,28 +118,11 @@ def make_gpt2_cp_train_step(
     loses the declared vma (known jax 0.9 limitation); the compiled TPU
     path keeps the checker on.
     """
-    check_vma = not (flash and interpret)
     axes = (data_axis, seq_axis)
     n_seq = world.axis_size(seq_axis)
-
-    if ulysses:
-        if flash:
-            from mpit_tpu.ops import flash_attention
-
-            inner = partial(flash_attention, interpret=interpret)
-        else:
-            from mpit_tpu.ops import reference_attention as inner
-        attn = partial(ulysses_attention, axis=seq_axis, inner=inner)
-    elif flash:
-        attn = partial(
-            ring_flash_attention, axis=seq_axis, interpret=interpret
-        )
-    else:
-        attn = partial(ring_attention, axis=seq_axis)
-
-    def attention_fn(q, k, v, *, causal=True):
-        return attn(q, k, v, causal=causal)
-
+    attention_fn, check_vma = make_seq_attention(
+        seq_axis, flash=flash, ulysses=ulysses, interpret=interpret
+    )
     model = GPT2(dataclasses.replace(cfg, attention_fn=attention_fn))
     # Shared ZeRO-1 plumbing (train.step), with SUM reduce semantics: the
     # CP loss is already normalized by the global token count.

@@ -61,6 +61,7 @@ from mpit_tpu.opt.sharded import grouped_state_specs
 from mpit_tpu.parallel.pipeline import (
     spmd_pipeline,
     spmd_pipeline_1f1b,
+    spmd_pipeline_interleaved_1f1b,
     stack_stage_params,
 )
 from mpit_tpu.train.step import TrainState
@@ -86,6 +87,35 @@ def split_gpt2_params(full_params, num_layers: int, n_pipe: int):
     return {"stages": stack_stage_params(stages), "rest": rest}
 
 
+def split_gpt2_params_interleaved(
+    full_params, num_layers: int, n_pipe: int, num_chunks: int
+):
+    """GPT2 params → ``{"stages": [n_pipe, V, k', ...], "rest": ...}`` —
+    the interleaved layout: global chunk ``v·P + i`` (the v-th trip
+    around the ring, device i) holds blocks ``[(v·P+i)·k' : …+k']``,
+    ``k' = num_layers / (P·V)``."""
+    total = n_pipe * num_chunks
+    if num_layers % total:
+        raise ValueError(
+            f"num_layers ({num_layers}) must divide by pipe*chunks ({total})"
+        )
+    k = num_layers // total
+    blocks = [full_params[f"block_{i}"] for i in range(num_layers)]
+    per_device = []
+    for i in range(n_pipe):
+        chunks = []
+        for v in range(num_chunks):
+            s = v * n_pipe + i
+            chunks.append(stack_stage_params(blocks[s * k : (s + 1) * k]))
+        per_device.append(stack_stage_params(chunks))
+    rest = {
+        name: sub
+        for name, sub in full_params.items()
+        if not name.startswith("block_")
+    }
+    return {"stages": stack_stage_params(per_device), "rest": rest}
+
+
 def make_gpt2_pp_train_step(
     cfg: GPT2Config,
     tx: optax.GradientTransformation,
@@ -96,6 +126,7 @@ def make_gpt2_pp_train_step(
     num_microbatches: int = 4,
     zero1: bool = False,
     schedule: str = "gpipe",
+    num_chunks: int = 2,
     donate: bool = True,
 ):
     """Build ``(init_fn, step_fn, state_specs)`` for pipeline-parallel GPT-2.
@@ -107,30 +138,40 @@ def make_gpt2_pp_train_step(
     docstring for why, and for the ``zero1`` restriction).
 
     ``schedule``: ``"gpipe"`` (all-forward scan + AD reverse pipeline —
-    the oracle; M in-flight microbatch residuals) or ``"1f1b"``
-    (interleaved one-fwd-one-bwd via
+    the oracle; M in-flight microbatch residuals), ``"1f1b"``
+    (one-fwd-one-bwd via
     :func:`~mpit_tpu.parallel.pipeline.spmd_pipeline_1f1b` — per-device
     activation memory bounded at ``2·P`` stage inputs independent of M,
     per-microbatch head/loss inside the schedule, stage recompute in the
-    backward tick). Same update semantics; trajectory-parity-tested
-    against each other and against single-device AD.
+    backward tick), or ``"interleaved"`` (virtual stages:
+    :func:`~mpit_tpu.parallel.pipeline.spmd_pipeline_interleaved_1f1b`
+    with ``num_chunks`` chunks per device; params in the
+    :func:`split_gpt2_params_interleaved` layout). Same update
+    semantics; trajectory-parity-tested against each other and against
+    single-device AD.
     """
     if cfg.tie_head:
         raise ValueError(
             "pipeline parallelism requires an untied LM head: "
             "GPT2Config(tie_head=False) — see parallel.pp docstring"
         )
-    if schedule not in ("gpipe", "1f1b"):
-        raise ValueError(f"schedule must be 'gpipe' or '1f1b', got {schedule!r}")
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
+        raise ValueError(
+            f"schedule must be 'gpipe', '1f1b' or 'interleaved', got "
+            f"{schedule!r}"
+        )
     n_pipe = world.axis_size(pipe_axis)
     n_data = world.axis_size(data_axis)
     # One stateless ZeRO-1 wrapper serves both placement groups (module
     # docstring): each group's leaves share one placement, so the flat
     # ravel is sound within it; the per-group state lives in opt_state.
     stx = gopt.sharded(tx, data_axis) if zero1 else None
-    if cfg.num_layers % n_pipe:
+    stage_div = n_pipe * (num_chunks if schedule == "interleaved" else 1)
+    if cfg.num_layers % stage_div:
         raise ValueError(
-            f"num_layers ({cfg.num_layers}) must divide by pipe={n_pipe}"
+            f"num_layers ({cfg.num_layers}) must divide by {stage_div} "
+            f"(pipe={n_pipe}"
+            + (f" x chunks={num_chunks})" if schedule == "interleaved" else ")")
         )
     axes = (data_axis, pipe_axis)
     block = Block(cfg)
@@ -278,7 +319,7 @@ def make_gpt2_pp_train_step(
             return jnp.where(is_last, jnp.mean(losses), 0.0)
 
         local = C.vary(state.params, axes)
-        if schedule == "1f1b":
+        if schedule in ("1f1b", "interleaved"):
             # The 1F1B schedule owns its backward (per-microbatch head +
             # vjp inside the ticks) and returns grads directly; embed and
             # head grads land only on pipe coords 0 / P-1 → psum over
@@ -303,7 +344,12 @@ def make_gpt2_pp_train_step(
                 "embed": {"wte": rest["wte"], "wpe": rest["wpe"]},
                 "head": {"ln_f": rest["ln_f"], "head": rest["head"]},
             }
-            loss, g = spmd_pipeline_1f1b(
+            sched_fn = (
+                spmd_pipeline_interleaved_1f1b
+                if schedule == "interleaved"
+                else spmd_pipeline_1f1b
+            )
+            loss, g = sched_fn(
                 stage_fn,
                 embed_fn,
                 head_loss_fn,

@@ -57,7 +57,6 @@ from mpit_tpu.parallel.megatron import (
 )
 from mpit_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
 from mpit_tpu.parallel.pp import split_gpt2_params
-from mpit_tpu.parallel.ring_attention import ring_attention
 from mpit_tpu.train.step import TrainState
 
 # Model-sharded block leaves (everything else in a block is replicated
@@ -155,6 +154,8 @@ def make_gpt2_dp_tp_pp_train_step(
     num_microbatches: int = 4,
     zero1: bool = True,
     donate: bool = True,
+    flash: bool = False,
+    interpret: bool | None = None,
 ):
     """GPT-2 training over a 3-D ``data x model x pipe`` mesh.
 
@@ -178,11 +179,24 @@ def make_gpt2_dp_tp_pp_train_step(
             f"num_heads ({cfg.num_heads}) must divide by model={n_model}"
         )
 
+    attn_kw = {}
+    check_vma = True
+    if flash:
+        # No seq axis on this tier: the Pallas flash kernel runs as a
+        # plain per-device attention over each microbatch's full
+        # sequence (the block's local heads) — round-2 verdict item 9.
+        from mpit_tpu.ops import flash_attention
+
+        attn_kw["attention_fn"] = partial(
+            flash_attention, interpret=interpret
+        )
+        check_vma = not interpret
     apply_block = partial(
         tp_transformer_block,
         num_heads=cfg.num_heads,
         axis=model_axis,
         dtype=cfg.dtype,
+        **attn_kw,
     )
     if cfg.remat:
         # Honor activation checkpointing inside the pipeline scan — at
@@ -406,6 +420,7 @@ def make_gpt2_dp_tp_pp_train_step(
                     _per_device_step,
                     in_specs=(specs, P(data_axis)),
                     out_specs=(specs, P()),
+                    check_vma=check_vma,
                 ),
                 donate_argnums=(0,) if donate else (),
             )
@@ -437,24 +452,45 @@ def make_gpt2_dp_cp_tp_train_step(
     model_axis: str = "model",
     zero1: bool = True,
     donate: bool = True,
+    flash: bool = False,
+    ulysses: bool = False,
+    interpret: bool | None = None,
 ):
-    """GPT-2 training over ``data x seq x model``: ring attention (CP)
-    INSIDE the Megatron-TP block — the round-1 verdict's "TP inside CP".
+    """GPT-2 training over ``data x seq x model``: sequence-parallel
+    attention (CP) INSIDE the Megatron-TP block — the round-1 verdict's
+    "TP inside CP".
 
     Params in :func:`stack_gpt2_blocks` layout; batch
     ``{"tokens": [B_global, T_global]}`` sharded ``P(data, seq)`` (use
     ``shard_batch(world, batch, spec=P('data', 'seq'))``). Cross-shard
     next-token targets exactly as ``parallel.cp``; the loss is globally
     normalized, so the data-axis reduction uses SUM semantics.
+
+    ``flash``/``ulysses`` select the sequence-attention implementation
+    (``parallel.cp.make_seq_attention``; round-2 verdict item 9): the
+    XLA K/V ring (default), the Pallas ring-flash kernel, or the Ulysses
+    all-to-all — which under TP sees the block's LOCAL heads, so it
+    needs ``num_heads / n_model`` divisible by ``n_seq``.
     """
+    from mpit_tpu.parallel.cp import make_seq_attention
+
     n_seq = world.axis_size(seq_axis)
     n_model = world.axis_size(model_axis)
     if cfg.num_heads % n_model:
         raise ValueError(
             f"num_heads ({cfg.num_heads}) must divide by model={n_model}"
         )
-
-    attention_fn = partial(ring_attention, axis=seq_axis)
+    if ulysses and (cfg.num_heads // n_model) % n_seq:
+        # Fail at construction with the GLOBAL head count — the trace-time
+        # error inside ulysses_attention reports only the TP-local value.
+        raise ValueError(
+            f"ulysses under TP re-shards the block's LOCAL heads: "
+            f"num_heads/model = {cfg.num_heads}//{n_model} = "
+            f"{cfg.num_heads // n_model} must divide by seq={n_seq}"
+        )
+    attention_fn, check_vma = make_seq_attention(
+        seq_axis, flash=flash, ulysses=ulysses, interpret=interpret
+    )
     apply_block = partial(
         tp_transformer_block,
         num_heads=cfg.num_heads,
@@ -644,6 +680,7 @@ def make_gpt2_dp_cp_tp_train_step(
                     _per_device_step,
                     in_specs=(specs, P(data_axis, seq_axis)),
                     out_specs=(specs, P()),
+                    check_vma=check_vma,
                 ),
                 donate_argnums=(0,) if donate else (),
             )

@@ -15,6 +15,7 @@ from mpit_tpu.utils.profiling import (
     collective_bytes,
     compiled_cost,
     roofline,
+    scaling_projection,
     trace,
     tree_bytes,
 )
@@ -28,6 +29,7 @@ __all__ = [
     "collective_bytes",
     "compiled_cost",
     "roofline",
+    "scaling_projection",
     "trace",
     "tree_bytes",
 ]

@@ -93,6 +93,32 @@ class TestCollectiveModel:
     def test_allreduce_gbps(self):
         assert allreduce_gbps(8e9, 8, 2.0) == 4.0
 
+    def test_scaling_projection_shape_and_cliff(self):
+        """The 8→256 scaling artifact: labeled modeled, monotone comm
+        cost, and a visible DCN cliff when chips exceed the slice size."""
+        from mpit_tpu.utils import scaling_projection
+
+        params = {"w": jnp.ones((4 << 20,), jnp.float32)}  # 16 MiB
+        proj = scaling_projection(0.1, 1000, params, slice_size=256)
+        assert proj["modeled"] is True
+        assert [p["chips"] for p in proj["points"]] == [8, 32, 64, 128, 256]
+        effs = [p["efficiency_no_overlap"] for p in proj["points"]]
+        assert all(0 < e <= 1 for e in effs)
+        assert effs == sorted(effs, reverse=True)  # efficiency decays with n
+        assert all(p["comm_dcn_s"] == 0 for p in proj["points"])  # one slice
+        assert 0 < proj["efficiency_8_to_256_no_overlap"] <= 1
+        # Multi-slice variant: crossing the slice boundary costs DCN time,
+        # and efficiency at 256 chips drops vs the single-slice layout.
+        multi = scaling_projection(0.1, 1000, params, slice_size=64)
+        pts = {p["chips"]: p for p in multi["points"]}
+        assert pts[64]["comm_dcn_s"] == 0
+        assert pts[128]["comm_dcn_s"] > 0 and pts[256]["comm_dcn_s"] > 0
+        flat = {p["chips"]: p for p in proj["points"]}
+        assert (
+            pts[256]["efficiency_no_overlap"]
+            < flat[256]["efficiency_no_overlap"]
+        )
+
     def test_hierarchical_dcn_phases(self):
         """Multi-slice grad sync decomposes into ICI + DCN phases; the
         DCN phase moves 1/per_slice of the payload across the slice
