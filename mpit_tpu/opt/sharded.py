@@ -44,6 +44,17 @@ def _pad_to(x: jax.Array, multiple: int) -> jax.Array:
     return x
 
 
+def shard_of(flat: jax.Array, axis: str) -> jax.Array:
+    """This device's contiguous shard of a flat vector (pad to the axis
+    size, slice by axis index) — THE shard choreography every ZeRO-1
+    layout shares; ``train/convert.py``'s cross-tier conversion imports
+    it so checkpoint conversion can never drift from the update path."""
+    n = lax.axis_size(axis)
+    padded = _pad_to(flat, n)
+    s = padded.shape[0] // n
+    return lax.dynamic_slice(padded, (lax.axis_index(axis) * s,), (s,))
+
+
 def sharded(
     tx: optax.GradientTransformation,
     axis: str,
@@ -76,15 +87,9 @@ def sharded(
     reference's gradient-push accumulation semantics.
     """
 
-    def _shard_of(flat: jax.Array):
-        n = lax.axis_size(axis)
-        padded = _pad_to(flat, n)
-        s = padded.shape[0] // n
-        return lax.dynamic_slice(padded, (lax.axis_index(axis) * s,), (s,))
-
     def init(params):
         flat, _ = ravel_pytree(params)
-        return tx.init(_shard_of(flat))
+        return tx.init(shard_of(flat, axis))
 
     def update(grads, state, params=None):
         if params is None:
@@ -97,7 +102,7 @@ def sharded(
         if mean_grads:
             g_shard = g_shard / n
         flat_p, _ = ravel_pytree(params)
-        p_shard = _shard_of(flat_p)
+        p_shard = shard_of(flat_p, axis)
         u_shard, new_state = tx.update(g_shard, state, p_shard)
         # invariant gather: updates are identical everywhere and typed
         # replicated, so they can exit shard_map with a replicated spec.

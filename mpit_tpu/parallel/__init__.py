@@ -46,6 +46,7 @@ from mpit_tpu.parallel.pp import (
     make_gpt2_pp_train_step,
     split_gpt2_params,
     split_gpt2_params_interleaved,
+    unsplit_gpt2_params,
 )
 from mpit_tpu.parallel.megatron import (
     column_parallel_dense,
@@ -58,11 +59,19 @@ from mpit_tpu.parallel.megatron import (
     unpack_qkv,
 )
 from mpit_tpu.parallel.ep import make_gpt2_moe_train_step
-from mpit_tpu.parallel.moe import MoEMLP, expert_parallel_moe
+from mpit_tpu.parallel.moe import (
+    MoEMLP,
+    dispatch_stats,
+    expert_parallel_moe,
+    moe_capacity,
+    top_k_dispatch,
+)
 from mpit_tpu.parallel.threed import (
     make_gpt2_dp_cp_tp_train_step,
     make_gpt2_dp_tp_pp_train_step,
+    merge_gpt2_params_3d,
     split_gpt2_params_3d,
+    unstack_gpt2_blocks,
     stack_gpt2_blocks,
 )
 
@@ -76,11 +85,14 @@ __all__ = [
     "make_gpt2_dp_tp_pp_train_step",
     "make_gpt2_dp_cp_tp_train_step",
     "split_gpt2_params_3d",
+    "merge_gpt2_params_3d",
+    "unstack_gpt2_blocks",
     "stack_gpt2_blocks",
     "make_gpt2_cp_train_step",
     "make_gpt2_pp_train_step",
     "split_gpt2_params",
     "split_gpt2_params_interleaved",
+    "unsplit_gpt2_params",
     "ring_attention",
     "ring_flash_attention",
     "ulysses_attention",
@@ -98,4 +110,7 @@ __all__ = [
     "tp_mlp",
     "MoEMLP",
     "expert_parallel_moe",
+    "dispatch_stats",
+    "moe_capacity",
+    "top_k_dispatch",
 ]

@@ -71,6 +71,26 @@ def moe_capacity(tokens: int, num_experts: int, k: int, capacity_factor: float) 
     return max(1, math.ceil(k * tokens * capacity_factor / num_experts))
 
 
+def dispatch_stats(dispatch, k: int):
+    """Observability for the capacity mechanism (round-2 verdict item 10).
+
+    ``dispatch``: the [S, E, C] 0/1 tensor from :func:`top_k_dispatch`.
+    Returns ``drop_rate`` — the fraction of requested (token, round)
+    assignments that found no slot (dropped tokens ride the residual
+    stream untouched) — and ``expert_load``, each expert's filled-slot
+    count. Under balanced routing at cf ≥ 1 the drop rate is ~0; under
+    skew it rises sharply (measured in ``tests/test_parallel.py::
+    TestMoECapacity``), which is exactly what the aux loss exists to
+    prevent.
+    """
+    s = dispatch.shape[0]
+    assigned = jnp.sum(dispatch)
+    return {
+        "drop_rate": 1.0 - assigned / (k * s),
+        "expert_load": jnp.sum(dispatch, axis=(0, 2)),
+    }
+
+
 def expert_parallel_moe(
     x,
     params: dict[str, Any],

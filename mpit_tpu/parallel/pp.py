@@ -87,6 +87,35 @@ def split_gpt2_params(full_params, num_layers: int, n_pipe: int):
     return {"stages": stack_stage_params(stages), "rest": rest}
 
 
+def unsplit_gpt2_params(split, num_layers: int):
+    """Inverse of :func:`split_gpt2_params`: stage-stacked layout →
+    the dense GPT2 param tree (canonical checkpoint format;
+    ``train/convert.py``). Rejects the interleaved layout (its leaves
+    carry an extra chunk dim — silent jax index-clamping would
+    otherwise duplicate the last chunk's params into most blocks)."""
+    stages = split["stages"]
+    probe = stages["ln1"]["scale"]  # rank 1 per block -> [P, k, D] here
+    if probe.ndim != 3:
+        raise ValueError(
+            f"unsplit_gpt2_params expects the split_gpt2_params layout "
+            f"([n_pipe, k, ...] stages); got a rank-{probe.ndim} ln1/scale "
+            "(interleaved layouts carry [n_pipe, V, k', ...])"
+        )
+    n_pipe = jax.tree.leaves(stages)[0].shape[0]
+    if num_layers % n_pipe or probe.shape[1] != num_layers // n_pipe:
+        raise ValueError(
+            f"stages [P={n_pipe}, k={probe.shape[1]}] do not cover "
+            f"num_layers={num_layers}"
+        )
+    k = num_layers // n_pipe
+    out = dict(split["rest"])
+    for i in range(num_layers):
+        out[f"block_{i}"] = jax.tree.map(
+            lambda l: l[i // k, i % k], stages
+        )
+    return out
+
+
 def split_gpt2_params_interleaved(
     full_params, num_layers: int, n_pipe: int, num_chunks: int
 ):

@@ -127,8 +127,8 @@ def ring_flash_attention(
     *,
     axis: str = "seq",
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,  # None: auto-tuned (ops.flash_attention)
+    block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """Ring attention with the fused Pallas kernel as the per-block compute.

@@ -143,6 +143,29 @@ def split_gpt2_params_3d(full_params, num_layers: int, n_pipe: int, n_model: int
     return split
 
 
+def merge_gpt2_params_3d(split, num_layers: int, n_model: int):
+    """Inverse of :func:`split_gpt2_params_3d`: 3-D stage layout →
+    dense GPT2 tree (``unpack_qkv`` then unsplit)."""
+    from mpit_tpu.parallel.megatron import unpack_qkv
+    from mpit_tpu.parallel.pp import unsplit_gpt2_params
+
+    undone = dict(split)
+    undone["stages"] = unpack_qkv(split["stages"], n_model)
+    return unsplit_gpt2_params(undone, num_layers)
+
+
+def unstack_gpt2_blocks(stacked, num_layers: int, n_model: int):
+    """Inverse of :func:`stack_gpt2_blocks`: block-stacked dp×cp×tp
+    layout → dense GPT2 tree."""
+    from mpit_tpu.parallel.megatron import unpack_qkv
+
+    blocks = unpack_qkv(stacked["blocks"], n_model)
+    out = dict(stacked["rest"])
+    for i in range(num_layers):
+        out[f"block_{i}"] = jax.tree.map(lambda l: l[i], blocks)
+    return out
+
+
 def make_gpt2_dp_tp_pp_train_step(
     cfg: GPT2Config,
     tx: optax.GradientTransformation,

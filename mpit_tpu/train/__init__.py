@@ -18,6 +18,13 @@ from mpit_tpu.train.guard import Diverged, DivergenceGuard
 from mpit_tpu.train.step import TrainState, make_eval_step, make_train_step
 from mpit_tpu.train.loop import Trainer, hardened_loop
 from mpit_tpu.train.checkpoint import CheckpointManager
+from mpit_tpu.train.convert import (
+    DenseState,
+    dense_from_3d,
+    dense_from_dp,
+    dp_from_dense,
+    threed_from_dense,
+)
 from mpit_tpu.train.metrics import MetricLogger, Throughput
 
 __all__ = [
@@ -29,6 +36,11 @@ __all__ = [
     "Trainer",
     "hardened_loop",
     "CheckpointManager",
+    "DenseState",
+    "dense_from_dp",
+    "dp_from_dense",
+    "dense_from_3d",
+    "threed_from_dense",
     "MetricLogger",
     "Throughput",
 ]

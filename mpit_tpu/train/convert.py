@@ -1,0 +1,337 @@
+"""Cross-tier checkpoint conversion (round-2 verdict item 6).
+
+On pods, resuming with a DIFFERENT parallelism layout is the normal
+recovery/rescale move: a DP-trained checkpoint must restore into a
+dp×tp×pp (or dp×cp×tp) mesh and back. RECOVERY.md's "same mesh shape
+required" constraint applies to in-place resume; this module lifts it at
+the checkpoint-format level.
+
+**Canonical format: the dense state.** ``DenseState`` is the plain flax
+GPT-2 param tree plus the optimizer *moments as dense trees* (one per
+vector leaf of the goo state, in tree order — trace for SGD-momentum;
+mu/nu for adam) and the step counter. Every tier converts to/from it:
+
+- DP (ZeRO-1 flat shards over ``data``)  ← :func:`dense_from_dp` /
+  :func:`dp_from_dense`
+- dp×tp×pp (three placement groups, per-group flat shards)
+  ← :func:`dense_from_3d` / :func:`threed_from_dense`
+
+The conversions are exact: ZeRO-1 state is ``tx.init`` of contiguous
+shards of the raveled (group) tree, so gathering + unraveling recovers
+the dense moments bit-for-bit, and re-sharding re-ravels them into the
+target tier's own layout — the same choreography the tiers' init/update
+use (``opt/sharded.py``), executed once at conversion time. Trajectory
+parity (dense ↔ DP ↔ 3-D mid-run switches vs an uninterrupted run) is
+tested per-leaf in ``tests/test_convert.py``.
+
+Scope notes: moments convert for the goo family (elementwise state,
+vector leaves — the ``opt/sharded.py`` precondition); scalar state
+leaves (adam's count) ride along replicated. Conversion runs at
+host-level (gather to numpy, re-place with the target tier's specs) —
+it is an offline checkpoint operation, not a training-step path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import PartitionSpec as P
+
+from mpit_tpu.train.step import TrainState
+
+
+@dataclasses.dataclass
+class DenseState:
+    """The canonical cross-tier checkpoint payload (host numpy)."""
+
+    step: int
+    params: Any  # dense GPT-2 param tree
+    moments: list  # dense trees, one per vector leaf of the goo state
+    scalars: list  # non-vector state leaves (e.g. adam count), in order
+
+
+def _is_vec(leaf) -> bool:
+    return getattr(leaf, "ndim", 0) >= 1
+
+
+# THE shard choreography (single source of truth with the update path:
+# a drift here would silently misalign converted moment shards).
+from mpit_tpu.opt.sharded import shard_of as _shard_of_1d
+
+
+def _shard_of(flat, axis):
+    return _shard_of_1d(flat, axis)
+
+
+def _local_view_3d(split):
+    """The dp×tp×pp tier's per-device param view (pipe dim stripped) —
+    shared by both conversion directions."""
+    return {
+        "stages": jax.tree.map(lambda l: l[0], split["stages"]),
+        "rest": split["rest"],
+    }
+
+
+def _fill_state(template, moment_shards, scalars):
+    """Replace ``template``'s vector leaves (in order) with
+    ``moment_shards`` and its scalar leaves with ``scalars``."""
+    leaves, treedef = jax.tree.flatten(template)
+    vec_it, sc_it = iter(moment_shards), iter(scalars)
+    out = [
+        next(vec_it) if _is_vec(l) else jnp.asarray(next(sc_it), l.dtype)
+        for l in leaves
+    ]
+    return jax.tree.unflatten(treedef, out)
+
+
+def _moment_vectors(opt_state) -> tuple[list, list]:
+    """(vector leaves, scalar leaves) of a goo state, in tree order."""
+    vecs, scalars = [], []
+    for leaf in jax.tree.leaves(opt_state):
+        (vecs if _is_vec(leaf) else scalars).append(leaf)
+    return vecs, scalars
+
+
+# ---------------------------------------------------------------------------
+# DP tier (train.step zero1 layout: flat shards over the data axis)
+# ---------------------------------------------------------------------------
+
+
+def dense_from_dp(state: TrainState) -> DenseState:
+    """DP ZeRO-1 ``TrainState`` → :class:`DenseState`.
+
+    The state's vector leaves are jax global arrays sharded over data;
+    indexing them gathers the full padded flat vector, which unravels
+    with the dense params' own unraveler.
+    """
+    params = jax.tree.map(np.asarray, state.params)
+    flat, unravel = ravel_pytree(params)
+    vecs, scalars = _moment_vectors(state.opt_state)
+    moments = [
+        jax.tree.map(np.asarray, unravel(jnp.asarray(v).ravel()[: flat.shape[0]]))
+        for v in vecs
+    ]
+    return DenseState(
+        step=int(state.step),
+        params=params,
+        moments=moments,
+        scalars=[np.asarray(s) for s in scalars],
+    )
+
+
+def dp_from_dense(
+    dense: DenseState,
+    tx: optax.GradientTransformation,
+    world,
+    *,
+    axis: str = "data",
+) -> TrainState:
+    """:class:`DenseState` → DP ZeRO-1 ``TrainState`` on ``world``.
+
+    Uses the shared ``zero1_state_fns`` specs; the fill runs one
+    shard_map so each device ravels the dense moments and keeps exactly
+    its own contiguous shard — the same slices ``opt/sharded.py`` owns.
+    """
+    from mpit_tpu.train.step import zero1_state_fns
+
+    _, state_specs, _ = zero1_state_fns(tx, world, axis=axis, zero1=True)
+    specs = state_specs(dense.params)
+
+    def per_device(params, *moments):
+        flat_p, _ = ravel_pytree(params)
+        template = tx.init(_shard_of(flat_p, axis))
+        shards = [_shard_of(ravel_pytree(m)[0], axis) for m in moments]
+        return TrainState(
+            step=jnp.asarray(dense.step, jnp.int32),
+            params=params,
+            opt_state=_fill_state(template, shards, dense.scalars),
+            extra=(),
+        )
+
+    f = world.shard_map(
+        per_device,
+        in_specs=(P(),) * (1 + len(dense.moments)),
+        out_specs=specs,
+    )
+    return jax.jit(f)(dense.params, *dense.moments)
+
+
+# ---------------------------------------------------------------------------
+# dp × tp × pp tier (parallel.threed split layout, three placement groups)
+# ---------------------------------------------------------------------------
+
+
+def threed_from_dense(
+    dense: DenseState,
+    tx: optax.GradientTransformation,
+    world,
+    cfg,
+    *,
+    data_axis: str = "data",
+    model_axis: str = "model",
+    pipe_axis: str = "pipe",
+) -> TrainState:
+    """:class:`DenseState` → the dp×tp×pp tier's ``TrainState``.
+
+    Params AND each dense moment tree pass through the tier's own
+    parameter converter (``split_gpt2_params_3d`` — moments are
+    param-shaped, so the same layout applies), then one shard_map
+    partitions them into the tier's three placement groups and keeps
+    each device's flat shard, mirroring the tier's ``_per_device_init``.
+    """
+    from mpit_tpu.parallel import make_gpt2_dp_tp_pp_train_step
+    from mpit_tpu.parallel.threed import (
+        _partition_block_tree,
+        split_gpt2_params_3d,
+    )
+
+    n_pipe = world.axis_size(pipe_axis)
+    n_model = world.axis_size(model_axis)
+    convert = lambda t: split_gpt2_params_3d(
+        t, cfg.num_layers, n_pipe, n_model
+    )
+    split_params = convert(dense.params)
+    split_moments = [convert(m) for m in dense.moments]
+
+    # The tier's own specs (via its factory — single source of truth).
+    _, _, state_specs = make_gpt2_dp_tp_pp_train_step(
+        cfg, tx, world, data_axis=data_axis, model_axis=model_axis,
+        pipe_axis=pipe_axis, zero1=True,
+    )
+    specs = state_specs(split_params)
+
+    _local_view = _local_view_3d
+
+    def _group_state(p_group, m_groups):
+        flat_p, _ = ravel_pytree(p_group)
+        template = tx.init(_shard_of(flat_p, data_axis))
+        shards = [
+            _shard_of(ravel_pytree(m)[0], data_axis) for m in m_groups
+        ]
+        return _fill_state(template, shards, dense.scalars)
+
+    def per_device(split, *moments):
+        local = _local_view(split)
+        locals_m = [_local_view(m) for m in moments]
+        g_sh, g_rep = _partition_block_tree(local["stages"])
+        m_sh = [_partition_block_tree(m["stages"])[0] for m in locals_m]
+        m_rep = [_partition_block_tree(m["stages"])[1] for m in locals_m]
+        opt_state = {
+            "tp_sharded": _group_state(g_sh, m_sh),
+            "tp_replicated": _group_state(g_rep, m_rep),
+            "rest": _group_state(
+                local["rest"], [m["rest"] for m in locals_m]
+            ),
+        }
+        return TrainState(
+            step=jnp.asarray(dense.step, jnp.int32),
+            params=split,
+            opt_state=opt_state,
+            extra=(),
+        )
+
+    f = world.shard_map(
+        per_device,
+        in_specs=(specs.params,) * (1 + len(split_moments)),
+        out_specs=specs,
+    )
+    return jax.jit(f)(split_params, *split_moments)
+
+
+def dense_from_3d(
+    state: TrainState,
+    tx: optax.GradientTransformation,
+    world,
+    cfg,
+    *,
+    data_axis: str = "data",
+    model_axis: str = "model",
+    pipe_axis: str = "pipe",
+) -> DenseState:
+    """The dp×tp×pp tier's ``TrainState`` → :class:`DenseState`.
+
+    Reverses :func:`threed_from_dense`: each placement group's flat
+    shards gather back to the group's raveled vector, unravel with the
+    LOCAL group structure per (pipe, model) coordinate, and the per-
+    coordinate trees reassemble into the split layout, which the param
+    inverse (``merge_gpt2_params_3d``) takes back to dense. Runs as one
+    shard_map gather per group (all-gather over data + the pipe/model
+    coordinates come out in the split layout's own sharding).
+    """
+    from mpit_tpu.parallel.threed import (
+        _merge,
+        _partition_block_tree,
+        merge_gpt2_params_3d,
+    )
+
+    n_model = world.axis_size(model_axis)
+
+    _local_view = _local_view_3d
+
+    def per_device(state):
+        local = _local_view(state.params)
+        g_sh, g_rep = _partition_block_tree(local["stages"])
+        from mpit_tpu.comm import collectives as C
+
+        def gather_group(p_group, sub_state):
+            flat_p, unravel = ravel_pytree(p_group)
+            vecs, _ = _moment_vectors(sub_state)
+            return [
+                unravel(
+                    C.allgather(v, data_axis, tiled=True, invariant=True)[
+                        : flat_p.shape[0]
+                    ]
+                )
+                for v in vecs
+            ]
+
+        m_sh = gather_group(g_sh, state.opt_state["tp_sharded"])
+        m_rep = gather_group(g_rep, state.opt_state["tp_replicated"])
+        m_rest = gather_group(local["rest"], state.opt_state["rest"])
+
+        out = []
+        for sh, rep, rest in zip(m_sh, m_rep, m_rest):
+            stages = _merge(sh, rep)
+            out.append(
+                {
+                    "stages": jax.tree.map(lambda l: l[None], stages),
+                    "rest": rest,
+                }
+            )
+        return tuple(out)
+
+    # Specs: moments come out in the params' split layout.
+    from mpit_tpu.parallel import make_gpt2_dp_tp_pp_train_step
+
+    _, _, state_specs = make_gpt2_dp_tp_pp_train_step(
+        cfg, tx, world, data_axis=data_axis, model_axis=model_axis,
+        pipe_axis=pipe_axis, zero1=True,
+    )
+    specs = state_specs(state.params)
+    n_moments = len(
+        [l for l in jax.tree.leaves(state.opt_state) if _is_vec(l)]
+    ) // 3  # three groups carry the same per-moment vector count
+    f = world.shard_map(
+        per_device,
+        in_specs=(specs,),
+        out_specs=(specs.params,) * n_moments,
+    )
+    moments_split = jax.jit(f)(state)
+
+    to_dense = lambda t: merge_gpt2_params_3d(
+        jax.tree.map(np.asarray, t), cfg.num_layers, n_model
+    )
+    _, scalars = _moment_vectors(state.opt_state["rest"])
+    return DenseState(
+        step=int(state.step),
+        params=to_dense(state.params),
+        moments=[to_dense(m) for m in moments_split],
+        scalars=[np.asarray(s) for s in scalars],
+    )

@@ -1,0 +1,225 @@
+"""Cross-tier checkpoint conversion (round-2 verdict item 6).
+
+The done-criterion test: train DP N steps → convert the FULL state
+(params + sharded goo moments + step) → continue on a dp×tp×pp mesh →
+the trajectory matches an uninterrupted dense single-device run
+per-leaf. And back: 3-D → dense → DP continues to the same result.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import mpit_tpu
+from mpit_tpu.data import SyntheticLM, shard_batch
+from mpit_tpu.models import GPT2, GPT2Config
+from mpit_tpu.opt import goo
+from mpit_tpu.train import (
+    dense_from_3d,
+    dense_from_dp,
+    dp_from_dense,
+    threed_from_dense,
+)
+
+CFG = GPT2Config.tiny(
+    num_heads=4, max_seq_len=32, num_layers=2, tie_head=False,
+    dtype=jnp.float32,
+)
+LR, MOM = 0.05, 0.9  # momentum ON: moments must survive conversion
+
+
+def _init_params():
+    model = GPT2(CFG)
+    return jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+
+
+def _batches(n, batch=8):
+    stream = SyntheticLM(vocab_size=CFG.vocab_size, seed=0).batches(batch, 32)
+    return [next(stream)["tokens"] for _ in range(n)]
+
+
+def _dense_reference(params, toks_list):
+    """Uninterrupted single-device run: the oracle trajectory."""
+    model = GPT2(CFG)
+    tx = goo(LR, MOM)
+
+    def loss_fn(p, toks):
+        # Same objective as the tiers: mean next-token xent over the
+        # [B, L-1] positions of a [B, L] window.
+        losses = model.apply(
+            {"params": p}, toks[:, :-1], targets=toks[:, 1:]
+        )
+        return jnp.mean(losses)
+
+    @jax.jit
+    def step(p, s, toks):
+        g = jax.grad(loss_fn)(p, toks)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    state = tx.init(params)
+    for toks in toks_list:
+        params, state = step(params, state, jnp.asarray(toks))
+    return params
+
+
+def _dp_loss_fn():
+    model = GPT2(CFG)
+
+    def loss_fn(p, batch):
+        toks = batch["tokens"]
+        losses = model.apply(
+            {"params": p}, toks[:, :-1], targets=toks[:, 1:]
+        )
+        return jnp.mean(losses), {}
+
+    return loss_fn
+
+
+class TestCrossTierRestore:
+    def test_dp_to_3d_and_back_matches_dense(self):
+        """DP 4 steps → 3-D mesh 4 steps → DP 2 steps, every switch via
+        the dense format — per-leaf equal to the uninterrupted run."""
+        from mpit_tpu.parallel import (
+            make_gpt2_dp_tp_pp_train_step,
+            merge_gpt2_params_3d,
+        )
+        from mpit_tpu.train import make_train_step
+
+        params0 = _init_params()
+        toks = _batches(10)
+        ref = _dense_reference(params0, toks)
+
+        tx = goo(LR, MOM)
+        # --- leg 1: DP (ZeRO-1) on a data=8 mesh, 4 steps --------------
+        dp_world = mpit_tpu.init({"data": 8}, set_default=False)
+        init_fn, step_fn, _ = make_train_step(
+            _dp_loss_fn(), tx, dp_world, zero1=True
+        )
+        state = init_fn(params0)
+        for t in toks[:4]:
+            state, _ = step_fn(state, shard_batch(dp_world, {"tokens": t}))
+
+        # --- switch: DP → dense → dp×tp×pp -----------------------------
+        dense = dense_from_dp(state)
+        assert dense.step == 4 and len(dense.moments) == 1  # SGD trace
+        d3_world = mpit_tpu.init(
+            {"data": 2, "model": 2, "pipe": 2}, set_default=False
+        )
+        tx3 = goo(LR, MOM)
+        state3 = threed_from_dense(dense, tx3, d3_world, CFG)
+        _, step3, _ = make_gpt2_dp_tp_pp_train_step(
+            CFG, tx3, d3_world, num_microbatches=2, zero1=True
+        )
+        for t in toks[4:8]:
+            state3, m = step3(state3, shard_batch(d3_world, {"tokens": t}))
+        assert np.isfinite(float(m["loss"]))
+        assert int(state3.step) == 8
+
+        # --- switch back: 3-D → dense → DP -----------------------------
+        dense2 = dense_from_3d(state3, tx3, d3_world, CFG)
+        assert dense2.step == 8
+        tx2 = goo(LR, MOM)
+        state_dp = dp_from_dense(dense2, tx2, dp_world)
+        init2, step2, _ = make_train_step(
+            _dp_loss_fn(), tx2, dp_world, zero1=True
+        )
+        del init2
+        for t in toks[8:]:
+            state_dp, _ = step2(
+                state_dp, shard_batch(dp_world, {"tokens": t})
+            )
+        assert int(state_dp.step) == 10
+
+        # Per-leaf parity with the uninterrupted dense run.
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+            ),
+            state_dp.params,
+            ref,
+        )
+
+    def test_dense_roundtrip_is_exact(self):
+        """dense → 3-D → dense round-trip is bit-exact for params AND
+        moments (the conversion itself adds no noise)."""
+        from mpit_tpu.train.convert import DenseState
+
+        params0 = _init_params()
+        moment = jax.tree.map(
+            lambda l: jnp.full_like(l, 0.5) * jnp.arange(
+                l.size, dtype=l.dtype
+            ).reshape(l.shape) / l.size,
+            params0,
+        )
+        dense = DenseState(
+            step=7,
+            params=jax.tree.map(np.asarray, params0),
+            moments=[jax.tree.map(np.asarray, moment)],
+            scalars=[],
+        )
+        world = mpit_tpu.init(
+            {"data": 2, "model": 2, "pipe": 2}, set_default=False
+        )
+        tx = goo(LR, MOM)
+        state3 = threed_from_dense(dense, tx, world, CFG)
+        back = dense_from_3d(state3, tx, world, CFG)
+        assert back.step == 7
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            back.params,
+            dense.params,
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            back.moments[0],
+            dense.moments[0],
+        )
+
+    def test_param_layout_inverses(self):
+        """The pure tree converters invert exactly."""
+        from mpit_tpu.parallel import (
+            merge_gpt2_params_3d,
+            split_gpt2_params,
+            split_gpt2_params_3d,
+            split_gpt2_params_interleaved,
+            stack_gpt2_blocks,
+            unsplit_gpt2_params,
+            unstack_gpt2_blocks,
+        )
+
+        full = _init_params()
+
+        def assert_eq(a, b):
+            jax.tree.map(
+                lambda x, y: np.testing.assert_array_equal(
+                    np.asarray(x), np.asarray(y)
+                ),
+                a,
+                b,
+            )
+
+        assert_eq(
+            unsplit_gpt2_params(split_gpt2_params(full, 2, 2), 2), full
+        )
+        assert_eq(
+            merge_gpt2_params_3d(split_gpt2_params_3d(full, 2, 2, 2), 2, 2),
+            full,
+        )
+        assert_eq(
+            unstack_gpt2_blocks(stack_gpt2_blocks(full, 2, 2), 2, 2), full
+        )
+        # interleaved: V=2, P=1 (2 layers -> 2 chunks of 1)
+        ilv = split_gpt2_params_interleaved(full, 2, 1, 2)
+        assert jax.tree.leaves(ilv["stages"])[0].shape[:3] == (1, 2, 1)

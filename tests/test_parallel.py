@@ -1373,6 +1373,117 @@ class Test3DComposition:
             assert shard.data.size * factor == l.size
 
 
+class TestMoECapacity:
+    """Capacity/overflow behavior at realistic load (round-2 verdict
+    item 10): drop rates under skewed routing at cf=1.25, aux-loss
+    response to imbalance, and dropped tokens riding the residual."""
+
+    def test_balanced_routing_drops_nothing(self):
+        from mpit_tpu.parallel import (
+            dispatch_stats,
+            moe_capacity,
+            top_k_dispatch,
+        )
+
+        s, e, k = 256, 8, 2
+        cap = moe_capacity(s, e, k, 1.25)  # ceil(2*256*1.25/8) = 80
+        assert cap == 80
+        # Perfectly balanced: token i prefers experts (i%e, (i+1)%e).
+        probs = np.full((s, e), 1e-3, np.float32)
+        probs[np.arange(s), np.arange(s) % e] = 0.6
+        probs[np.arange(s), (np.arange(s) + 1) % e] = 0.3
+        probs /= probs.sum(-1, keepdims=True)
+        dispatch, _ = top_k_dispatch(jnp.asarray(probs), k, cap)
+        stats = dispatch_stats(dispatch, k)
+        assert float(stats["drop_rate"]) == 0.0
+        # every expert gets exactly 2*256/8 = 64 <= 80 slots
+        np.testing.assert_array_equal(
+            np.asarray(stats["expert_load"]), np.full(e, 64.0)
+        )
+
+    def test_skewed_routing_drop_rate_is_exact(self):
+        """Full skew (every token's top-2 = experts 0 and 1): each hot
+        expert keeps exactly its capacity; the analytic drop rate at
+        cf=1.25 is 1 − 2·C/(2·S) = 68.75 % — the measured number the
+        aux loss exists to drive down."""
+        from mpit_tpu.parallel import (
+            dispatch_stats,
+            moe_capacity,
+            top_k_dispatch,
+        )
+
+        s, e, k = 256, 8, 2
+        cap = moe_capacity(s, e, k, 1.25)
+        probs = np.full((s, e), 1e-4, np.float32)
+        probs[:, 0] = 0.7
+        probs[:, 1] = 0.29
+        probs /= probs.sum(-1, keepdims=True)
+        dispatch, combine = top_k_dispatch(jnp.asarray(probs), k, cap)
+        stats = dispatch_stats(dispatch, k)
+        load = np.asarray(stats["expert_load"])
+        assert load[0] == cap and load[1] == cap and load[2:].sum() == 0
+        expected_drop = 1.0 - 2 * cap / (k * s)
+        np.testing.assert_allclose(
+            float(stats["drop_rate"]), expected_drop
+        )  # 0.6875 at these shapes
+        # Fully dropped tokens (both rounds overflowed) have zero combine
+        # weight everywhere -> the MoE output row is 0 and the token
+        # rides the residual untouched.
+        per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+        fully_dropped = per_token == 0
+        assert fully_dropped.sum() == s - cap  # tokens past both queues
+        cw = np.asarray(jnp.sum(combine, axis=(1, 2)))
+        assert (cw[fully_dropped] == 0).all()
+
+    def test_dropped_tokens_pass_through_as_zero(self):
+        from mpit_tpu.parallel import expert_parallel_moe
+
+        rng = np.random.RandomState(0)
+        d, e, f, s = 16, 4, 32, 64
+        params = {
+            "router": np.zeros((d, e), np.float32),
+            "w_in": rng.randn(e, d, f).astype(np.float32) * 0.1,
+            "b_in": np.zeros((e, f), np.float32),
+            "w_out": rng.randn(e, f, d).astype(np.float32) * 0.1,
+            "b_out": np.zeros((e, d), np.float32),
+        }
+        # Router biased entirely to expert 0 via the input direction.
+        params["router"][:, 0] = 1.0
+        x = jnp.asarray(np.abs(rng.randn(s, d)).astype(np.float32))
+        out, aux = expert_parallel_moe(
+            x, jax.tree.map(jnp.asarray, params), k=1, capacity_factor=0.25
+        )
+        # capacity = ceil(1*64*0.25/4) = 4: only 4 tokens served.
+        served = np.asarray(jnp.any(out != 0, axis=-1))
+        assert served.sum() == 4
+        assert (np.asarray(out)[~served] == 0).all()
+
+    def test_aux_loss_rises_under_imbalance(self):
+        """Balanced routing → aux ≈ 1 (its minimum); full skew → aux ≈ E
+        · f0 · p0 ≈ E·1·p0 >> 1. The documented contract: minimizing aux
+        pushes the router back toward balance."""
+        from mpit_tpu.parallel import expert_parallel_moe
+
+        rng = np.random.RandomState(1)
+        d, e, f, s = 16, 8, 32, 256
+        base = {
+            "w_in": jnp.asarray(rng.randn(e, d, f), jnp.float32) * 0.1,
+            "b_in": jnp.zeros((e, f)),
+            "w_out": jnp.asarray(rng.randn(e, f, d), jnp.float32) * 0.1,
+            "b_out": jnp.zeros((e, d)),
+        }
+        # Positive inputs so a one-column router reliably drives every
+        # token's top-1 to expert 0 (logit_0 = 5·Σ|x|).
+        x = jnp.asarray(np.abs(rng.randn(s, d)).astype(np.float32))
+        _, aux_balanced = expert_parallel_moe(
+            x, {**base, "router": jnp.zeros((d, e))}, k=2
+        )
+        skew = jnp.zeros((d, e)).at[:, 0].set(5.0)
+        _, aux_skew = expert_parallel_moe(x, {**base, "router": skew}, k=2)
+        assert float(aux_balanced) == pytest.approx(1.0, abs=0.1)
+        assert float(aux_skew) > 3.0
+
+
 class TestExpertParallelTier:
     """Round-2 item 6: the EP training tier (parallel.ep) — the round-1
     MoE dispatch shelf turned into a usable strategy."""
