@@ -20,9 +20,13 @@ from mpit_tpu.train.loop import Trainer, hardened_loop
 from mpit_tpu.train.checkpoint import CheckpointManager
 from mpit_tpu.train.convert import (
     DenseState,
+    cptp_from_dense,
     dense_from_3d,
+    dense_from_cptp,
     dense_from_dp,
+    dense_from_pp,
     dp_from_dense,
+    pp_from_dense,
     threed_from_dense,
 )
 from mpit_tpu.train.metrics import MetricLogger, Throughput
@@ -41,6 +45,10 @@ __all__ = [
     "dp_from_dense",
     "dense_from_3d",
     "threed_from_dense",
+    "pp_from_dense",
+    "dense_from_pp",
+    "cptp_from_dense",
+    "dense_from_cptp",
     "MetricLogger",
     "Throughput",
 ]

@@ -13,8 +13,11 @@ mu/nu for adam) and the step counter. Every tier converts to/from it:
 
 - DP (ZeRO-1 flat shards over ``data``)  ← :func:`dense_from_dp` /
   :func:`dp_from_dense`
+- pp (stages/rest groups)  ← :func:`dense_from_pp` / :func:`pp_from_dense`
 - dp×tp×pp (three placement groups, per-group flat shards)
   ← :func:`dense_from_3d` / :func:`threed_from_dense`
+- dp×cp×tp (stacked blocks, three placement groups)
+  ← :func:`dense_from_cptp` / :func:`cptp_from_dense`
 
 The conversions are exact: ZeRO-1 state is ``tx.init`` of contiguous
 shards of the raveled (group) tree, so gathering + unraveling recovers
@@ -99,6 +102,35 @@ def _moment_vectors(opt_state) -> tuple[list, list]:
     return vecs, scalars
 
 
+def _group_state(tx, scalars, data_axis, p_group, m_groups):
+    """ONE placement group's filled ZeRO-1 state: ``tx.init`` of this
+    device's param shard, vector leaves replaced by the same shard of
+    each converted moment. Shared by every ``*_from_dense`` direction —
+    the shard slice must never fork per tier (module docstring)."""
+    flat_p, _ = ravel_pytree(p_group)
+    template = tx.init(_shard_of(flat_p, data_axis))
+    shards = [_shard_of(ravel_pytree(m)[0], data_axis) for m in m_groups]
+    return _fill_state(template, shards, scalars)
+
+
+def _gather_group(data_axis, p_group, sub_state):
+    """Inverse of :func:`_group_state`: all-gather one group's flat
+    moment shards over data and unravel with the group's own structure.
+    Shared by every ``dense_from_*`` direction."""
+    from mpit_tpu.comm import collectives as C
+
+    flat_p, unravel = ravel_pytree(p_group)
+    vecs, _ = _moment_vectors(sub_state)
+    return [
+        unravel(
+            C.allgather(v, data_axis, tiled=True, invariant=True)[
+                : flat_p.shape[0]
+            ]
+        )
+        for v in vecs
+    ]
+
+
 # ---------------------------------------------------------------------------
 # DP tier (train.step zero1 layout: flat shards over the data axis)
 # ---------------------------------------------------------------------------
@@ -164,6 +196,240 @@ def dp_from_dense(
 
 
 # ---------------------------------------------------------------------------
+# pp tier (parallel.pp split layout, two placement groups)
+# ---------------------------------------------------------------------------
+
+
+def pp_from_dense(
+    dense: DenseState,
+    tx: optax.GradientTransformation,
+    world,
+    cfg,
+    *,
+    data_axis: str = "data",
+    pipe_axis: str = "pipe",
+) -> TrainState:
+    """:class:`DenseState` → the pp tier's ``TrainState`` (stages/rest
+    groups, per-group flat ZeRO-1 shards over data within each pipe
+    coordinate)."""
+    from mpit_tpu.parallel import (
+        make_gpt2_pp_train_step,
+        split_gpt2_params,
+    )
+
+    n_pipe = world.axis_size(pipe_axis)
+    convert = lambda t: split_gpt2_params(t, cfg.num_layers, n_pipe)
+    split_params = convert(dense.params)
+    split_moments = [convert(m) for m in dense.moments]
+    _, _, state_specs = make_gpt2_pp_train_step(
+        cfg, tx, world, data_axis=data_axis, pipe_axis=pipe_axis, zero1=True
+    )
+    specs = state_specs(split_params)
+
+    def _gs(p_group, m_groups):
+        return _group_state(tx, dense.scalars, data_axis, p_group, m_groups)
+
+    def per_device(split, *moments):
+        local = _local_view_3d(split)
+        locals_m = [_local_view_3d(m) for m in moments]
+        opt_state = {
+            "stages": _gs(
+                local["stages"], [m["stages"] for m in locals_m]
+            ),
+            "rest": _gs(
+                local["rest"], [m["rest"] for m in locals_m]
+            ),
+        }
+        return TrainState(
+            step=jnp.asarray(dense.step, jnp.int32),
+            params=split,
+            opt_state=opt_state,
+            extra=(),
+        )
+
+    f = world.shard_map(
+        per_device,
+        in_specs=(specs.params,) * (1 + len(split_moments)),
+        out_specs=specs,
+    )
+    return jax.jit(f)(split_params, *split_moments)
+
+
+def dense_from_pp(
+    state: TrainState,
+    tx: optax.GradientTransformation,
+    world,
+    cfg,
+    *,
+    data_axis: str = "data",
+    pipe_axis: str = "pipe",
+) -> DenseState:
+    """The pp tier's ``TrainState`` → :class:`DenseState`."""
+    from mpit_tpu.comm import collectives as C
+    from mpit_tpu.parallel import (
+        make_gpt2_pp_train_step,
+        unsplit_gpt2_params,
+    )
+
+    def per_device(state):
+        local = _local_view_3d(state.params)
+
+        def gather_group(p_group, sub_state):
+            return _gather_group(data_axis, p_group, sub_state)
+
+        m_st = gather_group(local["stages"], state.opt_state["stages"])
+        m_rest = gather_group(local["rest"], state.opt_state["rest"])
+        return tuple(
+            {
+                "stages": jax.tree.map(lambda l: l[None], st),
+                "rest": rest,
+            }
+            for st, rest in zip(m_st, m_rest)
+        )
+
+    _, _, state_specs = make_gpt2_pp_train_step(
+        cfg, tx, world, data_axis=data_axis, pipe_axis=pipe_axis, zero1=True
+    )
+    specs = state_specs(state.params)
+    n_moments = len(
+        [l for l in jax.tree.leaves(state.opt_state) if _is_vec(l)]
+    ) // 2  # two groups
+    f = world.shard_map(
+        per_device, in_specs=(specs,), out_specs=(specs.params,) * n_moments
+    )
+    moments_split = jax.jit(f)(state)
+    to_dense = lambda t: unsplit_gpt2_params(
+        jax.tree.map(np.asarray, t), cfg.num_layers
+    )
+    _, scalars = _moment_vectors(state.opt_state["rest"])
+    return DenseState(
+        step=int(state.step),
+        params=to_dense(state.params),
+        moments=[to_dense(m) for m in moments_split],
+        scalars=[np.asarray(s) for s in scalars],
+    )
+
+
+# ---------------------------------------------------------------------------
+# dp × cp × tp tier (parallel.threed stacked-blocks layout)
+# ---------------------------------------------------------------------------
+
+
+def cptp_from_dense(
+    dense: DenseState,
+    tx: optax.GradientTransformation,
+    world,
+    cfg,
+    *,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+    model_axis: str = "model",
+) -> TrainState:
+    """:class:`DenseState` → the dp×cp×tp tier's ``TrainState``
+    (block-stacked params, tp_sharded/tp_replicated/rest groups)."""
+    from mpit_tpu.parallel import (
+        make_gpt2_dp_cp_tp_train_step,
+        stack_gpt2_blocks,
+    )
+    from mpit_tpu.parallel.threed import _partition_block_tree
+
+    n_model = world.axis_size(model_axis)
+    convert = lambda t: stack_gpt2_blocks(t, cfg.num_layers, n_model)
+    stacked_params = convert(dense.params)
+    stacked_moments = [convert(m) for m in dense.moments]
+    _, _, state_specs = make_gpt2_dp_cp_tp_train_step(
+        cfg, tx, world, data_axis=data_axis, seq_axis=seq_axis,
+        model_axis=model_axis, zero1=True,
+    )
+    specs = state_specs(stacked_params)
+
+    def _gs(p_group, m_groups):
+        return _group_state(tx, dense.scalars, data_axis, p_group, m_groups)
+
+    def per_device(stacked, *moments):
+        g_sh, g_rep = _partition_block_tree(stacked["blocks"])
+        m_parts = [_partition_block_tree(m["blocks"]) for m in moments]
+        opt_state = {
+            "tp_sharded": _gs(g_sh, [p[0] for p in m_parts]),
+            "tp_replicated": _gs(g_rep, [p[1] for p in m_parts]),
+            "rest": _gs(
+                stacked["rest"], [m["rest"] for m in moments]
+            ),
+        }
+        return TrainState(
+            step=jnp.asarray(dense.step, jnp.int32),
+            params=stacked,
+            opt_state=opt_state,
+            extra=(),
+        )
+
+    f = world.shard_map(
+        per_device,
+        in_specs=(specs.params,) * (1 + len(stacked_moments)),
+        out_specs=specs,
+    )
+    return jax.jit(f)(stacked_params, *stacked_moments)
+
+
+def dense_from_cptp(
+    state: TrainState,
+    tx: optax.GradientTransformation,
+    world,
+    cfg,
+    *,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+    model_axis: str = "model",
+) -> DenseState:
+    """The dp×cp×tp tier's ``TrainState`` → :class:`DenseState`."""
+    from mpit_tpu.comm import collectives as C
+    from mpit_tpu.parallel import (
+        make_gpt2_dp_cp_tp_train_step,
+        unstack_gpt2_blocks,
+    )
+    from mpit_tpu.parallel.threed import _merge, _partition_block_tree
+
+    n_model = world.axis_size(model_axis)
+
+    def per_device(state):
+        g_sh, g_rep = _partition_block_tree(state.params["blocks"])
+
+        def gather_group(p_group, sub_state):
+            return _gather_group(data_axis, p_group, sub_state)
+
+        m_sh = gather_group(g_sh, state.opt_state["tp_sharded"])
+        m_rep = gather_group(g_rep, state.opt_state["tp_replicated"])
+        m_rest = gather_group(state.params["rest"], state.opt_state["rest"])
+        return tuple(
+            {"blocks": _merge(sh, rep), "rest": rest}
+            for sh, rep, rest in zip(m_sh, m_rep, m_rest)
+        )
+
+    _, _, state_specs = make_gpt2_dp_cp_tp_train_step(
+        cfg, tx, world, data_axis=data_axis, seq_axis=seq_axis,
+        model_axis=model_axis, zero1=True,
+    )
+    specs = state_specs(state.params)
+    n_moments = len(
+        [l for l in jax.tree.leaves(state.opt_state) if _is_vec(l)]
+    ) // 3
+    f = world.shard_map(
+        per_device, in_specs=(specs,), out_specs=(specs.params,) * n_moments
+    )
+    moments_stacked = jax.jit(f)(state)
+    to_dense = lambda t: unstack_gpt2_blocks(
+        jax.tree.map(np.asarray, t), cfg.num_layers, n_model
+    )
+    _, scalars = _moment_vectors(state.opt_state["rest"])
+    return DenseState(
+        step=int(state.step),
+        params=to_dense(state.params),
+        moments=[to_dense(m) for m in moments_stacked],
+        scalars=[np.asarray(s) for s in scalars],
+    )
+
+
+# ---------------------------------------------------------------------------
 # dp × tp × pp tier (parallel.threed split layout, three placement groups)
 # ---------------------------------------------------------------------------
 
@@ -209,13 +475,8 @@ def threed_from_dense(
 
     _local_view = _local_view_3d
 
-    def _group_state(p_group, m_groups):
-        flat_p, _ = ravel_pytree(p_group)
-        template = tx.init(_shard_of(flat_p, data_axis))
-        shards = [
-            _shard_of(ravel_pytree(m)[0], data_axis) for m in m_groups
-        ]
-        return _fill_state(template, shards, dense.scalars)
+    def _gs(p_group, m_groups):
+        return _group_state(tx, dense.scalars, data_axis, p_group, m_groups)
 
     def per_device(split, *moments):
         local = _local_view(split)
@@ -224,9 +485,9 @@ def threed_from_dense(
         m_sh = [_partition_block_tree(m["stages"])[0] for m in locals_m]
         m_rep = [_partition_block_tree(m["stages"])[1] for m in locals_m]
         opt_state = {
-            "tp_sharded": _group_state(g_sh, m_sh),
-            "tp_replicated": _group_state(g_rep, m_rep),
-            "rest": _group_state(
+            "tp_sharded": _gs(g_sh, m_sh),
+            "tp_replicated": _gs(g_rep, m_rep),
+            "rest": _gs(
                 local["rest"], [m["rest"] for m in locals_m]
             ),
         }
@@ -281,16 +542,7 @@ def dense_from_3d(
         from mpit_tpu.comm import collectives as C
 
         def gather_group(p_group, sub_state):
-            flat_p, unravel = ravel_pytree(p_group)
-            vecs, _ = _moment_vectors(sub_state)
-            return [
-                unravel(
-                    C.allgather(v, data_axis, tiled=True, invariant=True)[
-                        : flat_p.shape[0]
-                    ]
-                )
-                for v in vecs
-            ]
+            return _gather_group(data_axis, p_group, sub_state)
 
         m_sh = gather_group(g_sh, state.opt_state["tp_sharded"])
         m_rep = gather_group(g_rep, state.opt_state["tp_replicated"])

@@ -187,6 +187,162 @@ class TestCrossTierRestore:
             dense.moments[0],
         )
 
+    def test_pp_and_cptp_roundtrips_are_exact(self):
+        """dense → {pp, dp×cp×tp} → dense round-trips are bit-exact for
+        params and moments (all four tier families convert)."""
+        from mpit_tpu.train import (
+            cptp_from_dense,
+            dense_from_cptp,
+            dense_from_pp,
+            pp_from_dense,
+        )
+        from mpit_tpu.train.convert import DenseState
+
+        params0 = _init_params()
+        moment = jax.tree.map(
+            lambda l: jnp.arange(l.size, dtype=l.dtype).reshape(l.shape)
+            / max(l.size, 1),
+            params0,
+        )
+        dense = DenseState(
+            step=3,
+            params=jax.tree.map(np.asarray, params0),
+            moments=[jax.tree.map(np.asarray, moment)],
+            scalars=[],
+        )
+        tx = goo(LR, MOM)
+
+        def assert_eq(a, b):
+            jax.tree.map(
+                lambda x, y: np.testing.assert_array_equal(
+                    np.asarray(x), np.asarray(y)
+                ),
+                a,
+                b,
+            )
+
+        pp_world = mpit_tpu.init({"data": 4, "pipe": 2}, set_default=False)
+        st = pp_from_dense(dense, tx, pp_world, CFG)
+        back = dense_from_pp(st, tx, pp_world, CFG)
+        assert back.step == 3
+        assert_eq(back.params, dense.params)
+        assert_eq(back.moments[0], dense.moments[0])
+
+        ct_world = mpit_tpu.init(
+            {"data": 2, "seq": 2, "model": 2}, set_default=False
+        )
+        st = cptp_from_dense(dense, tx, ct_world, CFG)
+        back = dense_from_cptp(st, tx, ct_world, CFG)
+        assert back.step == 3
+        assert_eq(back.params, dense.params)
+        assert_eq(back.moments[0], dense.moments[0])
+
+    def test_pp_restore_continues_trajectory(self):
+        """DP 4 steps → pp tier 6 steps == uninterrupted dense run."""
+        from mpit_tpu.parallel import make_gpt2_pp_train_step
+        from mpit_tpu.train import make_train_step, pp_from_dense
+        from mpit_tpu.parallel import unsplit_gpt2_params
+
+        params0 = _init_params()
+        toks = _batches(10)
+        ref = _dense_reference(params0, toks)
+        tx = goo(LR, MOM)
+        dp_world = mpit_tpu.init({"data": 8}, set_default=False)
+        init_fn, step_fn, _ = make_train_step(
+            _dp_loss_fn(), tx, dp_world, zero1=True
+        )
+        state = init_fn(params0)
+        for t in toks[:4]:
+            state, _ = step_fn(state, shard_batch(dp_world, {"tokens": t}))
+        dense = dense_from_dp(state)
+
+        pp_world = mpit_tpu.init({"data": 4, "pipe": 2}, set_default=False)
+        txp = goo(LR, MOM)
+        st = pp_from_dense(dense, txp, pp_world, CFG)
+        _, stepp, _ = make_gpt2_pp_train_step(
+            CFG, txp, pp_world, num_microbatches=2, zero1=True
+        )
+        for t in toks[4:]:
+            st, m = stepp(st, shard_batch(pp_world, {"tokens": t}))
+        assert int(st.step) == 10
+        got = unsplit_gpt2_params(
+            jax.tree.map(np.asarray, st.params), CFG.num_layers
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+            ),
+            got,
+            ref,
+        )
+
+    def test_cptp_restore_continues_trajectory(self):
+        """DP 4 steps → dp×cp×tp tier 6 steps == uninterrupted dense run
+        (the converted state must satisfy the LIVE step_fn, not just the
+        reverse converter)."""
+        from mpit_tpu.parallel import (
+            make_gpt2_dp_cp_tp_train_step,
+            unstack_gpt2_blocks,
+        )
+        from mpit_tpu.train import cptp_from_dense, make_train_step
+
+        params0 = _init_params()
+        toks = _batches(10)
+        ref = _dense_reference(params0, toks)
+        tx = goo(LR, MOM)
+        dp_world = mpit_tpu.init({"data": 8}, set_default=False)
+        init_fn, step_fn, _ = make_train_step(
+            _dp_loss_fn(), tx, dp_world, zero1=True
+        )
+        state = init_fn(params0)
+        for t in toks[:4]:
+            state, _ = step_fn(state, shard_batch(dp_world, {"tokens": t}))
+        dense = dense_from_dp(state)
+
+        ct_world = mpit_tpu.init(
+            {"data": 2, "seq": 2, "model": 2}, set_default=False
+        )
+        txc = goo(LR, MOM)
+        st = cptp_from_dense(dense, txc, ct_world, CFG)
+        _, stepc, _ = make_gpt2_dp_cp_tp_train_step(
+            CFG, txc, ct_world, zero1=True
+        )
+        for t in toks[4:]:
+            # cp tier consumes [B, T] windows sharded P(data, seq); the
+            # batches carry [B, T+1] — drop the last column (the cp loss
+            # builds cross-shard targets internally).
+            st, m = stepc(
+                st,
+                shard_batch(
+                    ct_world,
+                    {"tokens": np.asarray(t)[:, :-1]},
+                    spec=P("data", "seq"),
+                ),
+            )
+        assert int(st.step) == 10
+        assert np.isfinite(float(m["loss"]))
+        got = unstack_gpt2_blocks(
+            jax.tree.map(np.asarray, st.params), CFG.num_layers, 2
+        )
+        # NOTE: the cp tier's objective differs from the DP one at the
+        # final position (cross-shard targets cover T-1 of T positions
+        # on [B, T] windows vs the DP loss's full [B, L-1] on [B, L]),
+        # so trajectories are compared only for approximate agreement on
+        # this short horizon — the conversion itself is exact
+        # (test_pp/3d trajectory tests prove per-leaf parity where the
+        # objectives match bit-for-bit).
+        flat_got = np.concatenate(
+            [np.asarray(l).ravel() for l in jax.tree.leaves(got)]
+        )
+        flat_ref = np.concatenate(
+            [np.asarray(l).ravel() for l in jax.tree.leaves(ref)]
+        )
+        cos = float(
+            np.dot(flat_got, flat_ref)
+            / (np.linalg.norm(flat_got) * np.linalg.norm(flat_ref))
+        )
+        assert cos > 0.999
+
     def test_param_layout_inverses(self):
         """The pure tree converters invert exactly."""
         from mpit_tpu.parallel import (
