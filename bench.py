@@ -324,12 +324,17 @@ def bench_gpt2(calls: int = 3, scan_steps: int = 8, warmup: int = 1, seq: int = 
     }
 
 
-def bench_moe(calls: int = 2, scan_steps: int = 4, warmup: int = 1, seq: int = 512):
+def bench_moe(calls: int = 2, scan_steps: int = 2, warmup: int = 1, seq: int = 256):
     """GPT-2-MoE throughput (round-2 verdict item 10: a measured MoE
     number). One chip = expert axis of 1; the routed dispatch, capacity
     drops, and aux loss all run exactly as on a pod — only the
     all-to-all is a local no-op. 8 experts, top-2, cf=1.25, MoE every
-    2nd block."""
+    2nd block. ZeRO-1 is OFF for this entry: the 322M-param MoE model's
+    single flat ravel compiles to a [40278624, 8] f32 reshape that the
+    TPU layout pass tile-pads 16× to a 20.6 GB allocation (measured
+    compile OOM at any batch). A 1-expert-axis chip gains nothing from
+    sharding anyway; the EP tier proper ravels per placement group
+    (`parallel/ep.py`), which stays far below the pathology."""
     import mpit_tpu
     from jax.sharding import PartitionSpec as P
     from mpit_tpu.data import SyntheticLM
@@ -340,7 +345,8 @@ def bench_moe(calls: int = 2, scan_steps: int = 4, warmup: int = 1, seq: int = 5
 
     world = mpit_tpu.init()
     n = world.num_devices
-    batch = 16 * n
+    batch = 8 * n
+    zero1 = False  # see docstring; single source for the step AND the record
 
     cfg = GPT2Config.small(max_seq_len=seq, head_dtype=jnp.bfloat16)
     moe = MoESettings(num_experts=8, k=2, capacity_factor=1.25, every=2)
@@ -356,7 +362,7 @@ def bench_moe(calls: int = 2, scan_steps: int = 4, warmup: int = 1, seq: int = 5
         return jnp.mean(losses) + 0.01 * aux, {}
 
     init_fn, step_fn, _ = make_train_step(
-        loss_fn, goo_adam(3e-4), world, zero1=True, scan_steps=scan_steps
+        loss_fn, goo_adam(3e-4), world, zero1=zero1, scan_steps=scan_steps
     )
     state = init_fn(params)
     stream = SyntheticLM(vocab_size=cfg.vocab_size).batches(batch, seq)
@@ -377,6 +383,7 @@ def bench_moe(calls: int = 2, scan_steps: int = 4, warmup: int = 1, seq: int = 5
         "experts": moe.num_experts,
         "k": moe.k,
         "capacity_factor": moe.capacity_factor,
+        "zero1": zero1,
         "final_loss": round(final_loss, 4),
     }
 
@@ -455,7 +462,10 @@ def main():
     alex = bench_alexnet()
     resnet = bench_resnet()
     gpt2 = bench_gpt2()
-    moe = bench_moe()
+    try:
+        moe = bench_moe()
+    except Exception as e:  # a secondary entry must not kill the artifact
+        moe = {"error": f"{type(e).__name__}: {e}"[:300]}
     ar = bench_allreduce()
     r1_alex, r1_gpt2 = _round1_baselines()
     print(

@@ -4,14 +4,26 @@ Beyond the reference (Torch7-era; SURVEY.md §3.3): trains
 :class:`mpit_tpu.models.GPT2` on a synthetic bigram-grammar token stream
 (learnable: loss falls from ``log(vocab)`` toward ``log(branching)``).
 
-Two SPMD tiers, selected by the mesh:
+The SPMD tier is selected by the mesh axes:
 
 - ``--mesh data=N`` (or empty): the shard_map tier — sync DP + ZeRO-1
   sharded goo_adam, same step as every other workload.
-- ``--mesh data=N,model=M``: the GSPMD/pjit tier — Megatron-pattern tensor
-  parallelism from :func:`mpit_tpu.parallel.gpt2_tp_rules` (column-shard
-  qkv/fc, row-shard proj/out, vocab-shard wte), optionally composed with
-  ``--fsdp-axis`` parameter sharding; XLA places the collectives.
+- ``--mesh data=N,model=M``: the GSPMD/pjit tier — Megatron-pattern TP
+  from :func:`mpit_tpu.parallel.gpt2_tp_rules`, optionally composed
+  with ``--fsdp-axis`` parameter sharding; XLA places the collectives.
+- ``--mesh data=N,seq=S``: context parallel (ring attention; ``--flash``
+  for the Pallas ring-flash kernel, ``--ulysses`` for the all-to-all).
+- ``--mesh data=N,pipe=P``: pipeline parallel — ``--pp-schedule
+  gpipe|1f1b|interleaved`` (``--pp-chunks V`` virtual stages).
+- ``--mesh data=N,model=M,pipe=P``: 3-D — Megatron blocks as pipeline
+  stages (``--flash`` supported).
+- ``--mesh data=N,seq=S,model=M``: 3-D — sequence-parallel attention
+  INSIDE the Megatron block (``--flash``/``--ulysses`` supported).
+- ``--mesh data=N,expert=E``: expert parallel — routed-MoE MLPs
+  (``--moe-experts/--moe-k/--moe-capacity``).
+
+All tiers share the hardened drive loop (checkpoint/resume, SIGTERM
+drain, divergence rollback, prefetch — ``train.loop.hardened_loop``).
 """
 
 from __future__ import annotations
