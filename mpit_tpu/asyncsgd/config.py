@@ -62,6 +62,13 @@ class TrainConfig:
     profile_dir: str = ""  # capture a jax.profiler trace of steps 2..5
     ckpt_dir: str = ""  # orbax checkpoint directory ("" = no checkpoints)
     ckpt_every: int = 0
+    # Elastic rescale via the geometry-free dense .npz (train/convert.py):
+    # --save-dense writes it at run end (preemption drain included);
+    # --resume-dense restores it onto the CURRENT mesh — any data-axis
+    # size, ZeRO-1 shards re-cut. Unlike --ckpt-dir (geometry-pinned
+    # in-place resume), this is the preempt -> restore-on-fewer-chips path.
+    save_dense: str = ""
+    resume_dense: str = ""
     eval_batch: int = 256
     # Periodic full-val-split evaluation (top-1/top-5 sweep): every N
     # steps, iterate the whole val split (runner.run_spmd eval hook);
@@ -69,10 +76,16 @@ class TrainConfig:
     eval_every: int = 0
     eval_batches: int = 0  # cap the sweep (0 = full split; synthetic: 8)
     # Input augmentation for the classification pipelines
-    # (data/augment.py): random shift-crop + horizontal flip on the
-    # train stream. The 58% top-1 north star is unreachable without it.
+    # (data/augment.py). The 58% top-1 north star is unreachable
+    # without it. --augment-mode shift: random shift-crop (crop_pad) +
+    # hflip (MNIST-grade); rrc: random-resized-crop with scale/aspect
+    # jitter (ImageNet-grade), training at --train-size (0 = stored
+    # image size) with center-cropped eval.
     augment: bool = False
+    augment_mode: str = "shift"  # shift | rrc
     crop_pad: int = 4
+    train_size: int = 0
+    rrc_min_scale: float = 0.08  # min crop-area fraction for rrc
     max_restores: int = 1  # checkpoint restores after a diverged loss
     spike_factor: float = 0.0  # >0: treat loss > factor*EMA as divergence
     seed: int = 0

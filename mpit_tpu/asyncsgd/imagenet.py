@@ -68,12 +68,15 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
     def eval_fn(params, extra, batch):
         del extra
         logits = model.apply({"params": params}, batch["image"])
+        v = batch.get("valid")
         out = {
-            "loss": runner.softmax_xent(logits, batch["label"]),
-            "top1": runner.accuracy(logits, batch["label"]),
+            "loss": runner.softmax_xent(logits, batch["label"], v),
+            "top1": runner.accuracy(logits, batch["label"], v),
         }
         if cfg.num_classes > 5:
-            out["top5"] = runner.topk_accuracy(logits, batch["label"], 5)
+            out["top5"] = runner.topk_accuracy(logits, batch["label"], 5, v)
+        if v is not None:
+            out["_weight"] = jnp.sum(v)  # exact-count combine (runner.py)
         return out
 
     stream = runner.make_stream(cfg, dataset)

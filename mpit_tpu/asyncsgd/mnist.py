@@ -60,10 +60,14 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
     def eval_fn(params, extra, batch):
         del extra
         logits = model.apply({"params": params}, batch["image"])
-        return {
-            "loss": runner.softmax_xent(logits, batch["label"]),
-            "top1": runner.accuracy(logits, batch["label"]),
+        v = batch.get("valid")
+        out = {
+            "loss": runner.softmax_xent(logits, batch["label"], v),
+            "top1": runner.accuracy(logits, batch["label"], v),
         }
+        if v is not None:
+            out["_weight"] = jnp.sum(v)  # exact-count combine (runner.py)
+        return out
 
     stream = runner.make_stream(cfg, dataset)
     return runner.run_spmd(

@@ -39,19 +39,31 @@ from mpit_tpu.train import (
 )
 
 
-def softmax_xent(logits, labels):
+def _wmean(per_example, valid):
+    """Mean over real rows only: ``valid`` is the pad mask the val sweep
+    attaches so the final partial batch counts its N%B rows exactly
+    (round-3 verdict: the remainder drop biased the north-star top-1)."""
+    if valid is None:
+        return jnp.mean(per_example)
+    return jnp.sum(per_example * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+def softmax_xent(logits, labels, valid=None):
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+    per = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+    return _wmean(per, valid)
 
 
-def accuracy(logits, labels):
-    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+def accuracy(logits, labels, valid=None):
+    per = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    return _wmean(per, valid)
 
 
-def topk_accuracy(logits, labels, k: int = 5):
+def topk_accuracy(logits, labels, k: int = 5, valid=None):
     """Top-k accuracy (the ImageNet top-5 convention)."""
     _, idx = jax.lax.top_k(logits, k)
-    return jnp.mean(jnp.any(idx == labels[:, None], axis=-1).astype(jnp.float32))
+    per = jnp.any(idx == labels[:, None], axis=-1).astype(jnp.float32)
+    return _wmean(per, valid)
 
 
 def classification_dataset(cfg: TrainConfig, synthetic_factory):
@@ -66,7 +78,18 @@ def classification_dataset(cfg: TrainConfig, synthetic_factory):
             cfg.data_dir,
             seed=cfg.seed,
             augment=cfg.augment,
+            augment_mode=cfg.augment_mode,
             crop_pad=cfg.crop_pad,
+            train_size=cfg.train_size,
+            rrc_scale=(cfg.rrc_min_scale, 1.0),
+        )
+    if (cfg.augment and cfg.augment_mode != "shift") or cfg.train_size:
+        # The synthetic streams implement shift-crop only; silently
+        # running a different augmentation than run_meta records would
+        # corrupt experiment comparisons (round-4 review finding).
+        raise SystemExit(
+            "--augment-mode rrc / --train-size need --data-dir (the "
+            "synthetic streams implement shift-crop augmentation only)"
         )
     ds = synthetic_factory()
     ds.augment = cfg.augment
@@ -136,7 +159,9 @@ def run_meta(cfg: TrainConfig) -> dict:
         "data_dir": os.path.abspath(cfg.data_dir) if cfg.data_dir else "",
         "stream_impl": "native_core" if uses_native_core else "python",
         "augment": cfg.augment,
+        "augment_mode": cfg.augment_mode if cfg.augment else "",
         "crop_pad": cfg.crop_pad if cfg.augment else 0,
+        "train_size": cfg.train_size,
         "easgd": cfg.easgd,
     }
     if cfg.easgd:
@@ -215,6 +240,24 @@ def run_spmd(
     )
     state = init_fn(params, extra)
 
+    if (cfg.resume_dense or cfg.save_dense) and (not cfg.zero1 or stateful):
+        # Fail before any training happens: the dense format carries the
+        # ZeRO-1 DP layout and no stateful extras (BatchNorm stats).
+        raise SystemExit(
+            "--resume-dense/--save-dense convert the ZeRO-1 DP layout; "
+            "run with --zero1 true and a stateless model (BatchNorm "
+            "models use same-geometry --ckpt-dir resume)"
+        )
+    if cfg.resume_dense:
+        # Elastic rescale (RECOVERY.md §4): restore the geometry-free
+        # dense .npz onto THIS mesh — any data-axis size; ZeRO-1 shards
+        # are re-cut by dp_from_dense. Sync-DP trajectories are mesh-size
+        # invariant given the same global batches, so the continuation
+        # matches an uninterrupted run at the new size.
+        from mpit_tpu.train import dp_from_dense, load_dense
+
+        state = dp_from_dense(load_dense(cfg.resume_dense), tx, world)
+
     ckpt = None
     if cfg.ckpt_dir:
         ckpt = CheckpointManager(cfg.ckpt_dir, world)
@@ -255,9 +298,12 @@ def run_spmd(
     )
     logger.log(start_step, {"comm_" + k: v for k, v in comm.summary().items()})
 
-    # Periodic full-val-split evaluation: average eval_fn's metrics over
-    # the whole sweep (equal-sized batches, so the plain mean is the
-    # per-example mean; remainder rows are dropped by val_batches).
+    # Periodic full-val-split evaluation: exact per-example mean over the
+    # whole sweep. Batches carrying a "valid" pad mask report "_weight"
+    # (their real-row count) and are combined as sum(m*w)/sum(w), so the
+    # padded final partial batch contributes exactly its N%B real rows —
+    # top-1/top-5 cover all N samples (round-3 verdict item 9). Batches
+    # without the mask weight 1 each (equal-sized-batch mean, as before).
     # Gated on --eval-every > 0, per config.py: the default remains the
     # cheap single held-out-batch eval at the end.
     eval_hook = None
@@ -267,13 +313,17 @@ def run_spmd(
 
         def eval_hook(state):
             totals: dict[str, float] = {}
-            n = 0
+            denom = 0.0
             for b in val_sweep():
-                m = ev_sweep(state, _shard(world, b, axis=axis))
+                m = {
+                    k: float(v)
+                    for k, v in ev_sweep(state, _shard(world, b, axis=axis)).items()
+                }
+                w = m.pop("_weight", 1.0)
                 for k, v in m.items():
-                    totals[k] = totals.get(k, 0.0) + float(v)
-                n += 1
-            return {k: v / n for k, v in totals.items()} if n else {}
+                    totals[k] = totals.get(k, 0.0) + v * w
+                denom += w
+            return {k: v / denom for k, v in totals.items()} if denom else {}
 
     # The hardened drive loop — prefetch, preemption drain, divergence
     # guard + older-checkpoint backoff, profile window — shared with the
@@ -298,6 +348,16 @@ def run_spmd(
         eval_hook=eval_hook,
     )
     state = result["state"]
+
+    if cfg.save_dense:
+        # The geometry-free artifact for elastic rescale: written on every
+        # exit path (clean end AND preemption drain), so a SIGTERMed run
+        # can resume on a different mesh size via --resume-dense.
+        from mpit_tpu.train import dense_from_dp, save_dense as _save_dense
+
+        _save_dense(cfg.save_dense, dense_from_dp(state))
+        logger.log(int(state.step), {"event": "dense_saved",
+                                     "path": cfg.save_dense})
 
     out = {
         "mode": "spmd",

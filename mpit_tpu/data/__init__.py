@@ -17,8 +17,16 @@ so the pipeline design is:
 - :mod:`mpit_tpu.data.loader` — batching, host→device prefetch (double
   buffered), and global-batch sharding over the mesh's data axis. Real
   dataset loaders plug in behind the same iterator interface.
+- :mod:`mpit_tpu.data.images` — real-image ingestion (round 4): PIL-backed
+  image-directory → npy conversion, done once offline; train-time
+  scale/aspect jitter comes from ``augment.random_resized_crop``.
 """
 
+from mpit_tpu.data.augment import (
+    augment_images,
+    center_crop,
+    random_resized_crop,
+)
 from mpit_tpu.data.filedata import (
     FileClassification,
     FileLM,
@@ -34,6 +42,8 @@ from mpit_tpu.data.synthetic import (
     synthetic_mnist,
 )
 
+from mpit_tpu.data.images import decode_image, import_image_directory
+
 __all__ = [
     "SyntheticClassification",
     "SyntheticLM",
@@ -46,4 +56,9 @@ __all__ = [
     "write_lm",
     "Prefetcher",
     "shard_batch",
+    "augment_images",
+    "random_resized_crop",
+    "center_crop",
+    "decode_image",
+    "import_image_directory",
 ]

@@ -80,13 +80,21 @@ class FileClassification:
     data_dir: str
     seed: int = 0
     normalize: bool = True  # uint8 -> float32 in [0, 1)
-    # Train-split augmentation (data/augment.py): random shift-crop +
-    # horizontal flip. Applied to batches() only — eval_batch/val_batches
-    # always see clean images. Per-batch counter-seeded, so skip=N resume
-    # replays the augmented stream exactly.
+    # Train-split augmentation (data/augment.py). Applied to batches()
+    # only — eval_batch/val_batches always see deterministic images.
+    # Per-batch counter-seeded, so skip=N resume replays the augmented
+    # stream exactly. Two modes:
+    #   "shift": random shift-crop (crop_pad) + hflip — MNIST-grade.
+    #   "rrc":   random-resized-crop (scale/aspect jitter, ImageNet-grade)
+    #            to train_size (0 = stored size); the val/eval side is
+    #            center-cropped to the same size so shapes agree.
     augment: bool = False
+    augment_mode: str = "shift"
     crop_pad: int = 4
     hflip: bool = True
+    train_size: int = 0
+    rrc_scale: tuple = (0.08, 1.0)
+    rrc_ratio: tuple = (3 / 4, 4 / 3)
 
     def __post_init__(self):
         with open(os.path.join(self.data_dir, _META)) as f:
@@ -120,8 +128,18 @@ class FileClassification:
         return len(self._images)
 
     @property
-    def image_shape(self) -> tuple[int, ...]:
+    def stored_image_shape(self) -> tuple[int, ...]:
+        """Shape of the rows on disk (pre-crop)."""
         return tuple(self._images.shape[1:])
+
+    @property
+    def image_shape(self) -> tuple[int, ...]:
+        """Shape of the images batches actually yield — ``train_size``
+        when set (the model-geometry number), else the stored shape."""
+        stored = self.stored_image_shape
+        if self.train_size and len(stored) == 3:
+            return (self.train_size, self.train_size, stored[2])
+        return stored
 
     def _assemble(self, images: np.ndarray) -> np.ndarray:
         out = np.ascontiguousarray(images)
@@ -129,14 +147,39 @@ class FileClassification:
             out = out.astype(np.float32) / 255.0
         return out.astype(np.float32, copy=False)
 
+    def _out_hw(self) -> tuple[int, int] | None:
+        """(H, W) every yielded batch must have; None = stored size."""
+        if not self.train_size:
+            return None
+        return (self.train_size, self.train_size)
+
+    def _eval_view(self, images: np.ndarray) -> np.ndarray:
+        """Deterministic val/eval-side geometry: center-crop to the train
+        size so eval batches match the model the train stream shaped."""
+        hw = self._out_hw()
+        if hw is None or images.shape[1:3] == hw:
+            return images
+        from mpit_tpu.data.augment import center_crop
+
+        return center_crop(images, *hw)
+
     def batches(
-        self, batch_size: int, *, seed: int | None = None, skip: int = 0
+        self,
+        batch_size: int,
+        *,
+        seed: int | None = None,
+        skip: int = 0,
+        native_augment: bool = False,
     ) -> Iterator[dict[str, np.ndarray]]:
         """Infinite stream of ``{"image": [B,...] f32, "label": [B] i32}``:
         a fresh seeded shuffle every epoch, last partial batch dropped
         (static shapes — XLA recompiles on shape change). ``skip=N``
         fast-forwards to batch N drawing only the epoch permutations —
-        no batch assembly/IO for the skipped range (checkpoint resume)."""
+        no batch assembly/IO for the skipped range (checkpoint resume).
+        ``native_augment`` (the ``--native`` path, via
+        :meth:`native_batches`) runs rrc augmentation through the C++
+        core when built — same counter-seeding shape, bit-different /
+        distribution-identical (the established native contract)."""
         n = len(self)
         if batch_size > n:
             raise ValueError(
@@ -154,8 +197,6 @@ class FileClassification:
                 idx = np.sort(order[lo : lo + batch_size])  # mmap-friendly
                 images = self._assemble(self._images[idx])
                 if self.augment:
-                    from mpit_tpu.data.augment import augment_images
-
                     # Counter-based per-batch RNG (independent of the
                     # epoch-permutation stream): augmentation replays
                     # across seek-based resume without drawing for the
@@ -163,9 +204,45 @@ class FileClassification:
                     arng = np.random.RandomState(
                         (base * 2_000_003 + produced) % 2**31
                     )
-                    images = augment_images(
-                        images, arng, pad=self.crop_pad, hflip=self.hflip
-                    )
+                    if self.augment_mode == "rrc":
+                        out = None
+                        if native_augment:
+                            from mpit_tpu.data import native as _native
+
+                            out = _native.rrc_batch(
+                                images,
+                                seed=base,
+                                ticket=produced,
+                                out_hw=self._out_hw(),
+                                scale=self.rrc_scale,
+                                ratio=self.rrc_ratio,
+                                hflip=self.hflip,
+                            )
+                        if out is None:  # no native build: numpy path
+                            from mpit_tpu.data.augment import (
+                                random_resized_crop,
+                            )
+
+                            out = random_resized_crop(
+                                images,
+                                arng,
+                                out_hw=self._out_hw(),
+                                scale=self.rrc_scale,
+                                ratio=self.rrc_ratio,
+                                hflip=self.hflip,
+                            )
+                        images = out
+                    else:
+                        from mpit_tpu.data.augment import augment_images
+
+                        images = self._eval_view(
+                            augment_images(
+                                images, arng,
+                                pad=self.crop_pad, hflip=self.hflip,
+                            )
+                        )
+                else:
+                    images = self._eval_view(images)
                 produced += 1
                 yield {"image": images, "label": self._labels[idx]}
 
@@ -181,25 +258,35 @@ class FileClassification:
     ) -> Iterator[dict[str, np.ndarray]]:
         """Ordered sweep over the whole val split (train if absent) — the
         full top-1 evaluation pass (BASELINE.json north star is measured
-        on it). Finite iterator; the last partial batch is dropped
-        (static shapes), so coverage is ``floor(n/B)·B`` rows.
-        ``num_batches`` caps the sweep (tests / quick evals). Never
+        on it). Finite iterator covering ALL ``n`` rows exactly: the last
+        partial batch is zero-padded to ``batch_size`` (static shapes) and
+        every batch carries a ``"valid"`` float mask (1 real / 0 pad) so
+        the weighted eval path counts denominators exactly — no remainder
+        drop. ``num_batches`` caps the sweep (tests / quick evals). Never
         augmented."""
         images, labels = self._val_images, self._val_labels
         if images is None:
             images, labels = self._images, self._labels
         n = len(images)
-        total = n // batch_size
+        full = n // batch_size
+        rem = n % batch_size
+        total = full + (1 if rem else 0)
         if num_batches is not None:
             total = min(total, num_batches)
         for b in range(total):
             lo = b * batch_size
-            yield {
-                "image": self._assemble(images[lo : lo + batch_size]),
-                "label": np.asarray(labels[lo : lo + batch_size]).astype(
-                    np.int32
-                ),
-            }
+            hi = min(lo + batch_size, n)
+            imgs = self._eval_view(self._assemble(images[lo:hi]))
+            labs = np.asarray(labels[lo:hi]).astype(np.int32)
+            valid = np.ones(hi - lo, np.float32)
+            if hi - lo < batch_size:
+                pad = batch_size - (hi - lo)
+                imgs = np.concatenate(
+                    [imgs, np.zeros((pad, *imgs.shape[1:]), imgs.dtype)]
+                )
+                labs = np.concatenate([labs, np.zeros(pad, np.int32)])
+                valid = np.concatenate([valid, np.zeros(pad, np.float32)])
+            yield {"image": imgs, "label": labs, "valid": valid}
 
     def eval_batch(self, batch_size: int, *, seed: int = 10_000):
         """One deterministic batch from the val split (train if absent)."""
@@ -213,15 +300,20 @@ class FileClassification:
             )
         )
         return {
-            "image": self._assemble(images[idx]),
+            "image": self._eval_view(self._assemble(images[idx])),
             "label": np.asarray(labels[idx]).astype(np.int32),
         }
 
     def native_batches(self, batch_size: int, **kw):
-        # Pure-Python alias (file IO is mmap'd numpy; no separate C++
-        # path) — forward skip so seek-based resume works under --native.
+        # File IO stays mmap'd numpy (no C++ path for the gather), but
+        # rrc augmentation routes through the C++ core's mpit_rrc_batch
+        # when built — forward skip so seek-based resume works under
+        # --native.
         return self.batches(
-            batch_size, seed=kw.get("seed"), skip=kw.get("skip", 0)
+            batch_size,
+            seed=kw.get("seed"),
+            skip=kw.get("skip", 0),
+            native_augment=True,
         )
 
 

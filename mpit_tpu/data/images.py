@@ -1,0 +1,153 @@
+"""Real-image ingestion: image directory → the npy dataset format.
+
+The reference trains ImageNet AlexNet from JPEG directories through
+Torch's dataset loaders (SURVEY.md §3.2 A5); this module is the
+TPU-native equivalent of that ingestion step, done ONCE offline instead
+of per-epoch: decode every image with PIL, shorter-side resize +
+center-crop to a uniform storage size, and write the
+``data/filedata.py`` npy format (mmap-served, page-cache-shuffled).
+Train-time scale/aspect jitter then comes from
+``data/augment.py::random_resized_crop`` over the stored images — the
+standard TPU input recipe (store a modestly-oversized uniform copy; crop
+smaller training views from it) rather than per-step JPEG decode.
+
+Directory conventions accepted by :func:`import_image_directory`:
+
+    src/train/<class_name>/*.{jpg,jpeg,png,bmp}   + src/val/<class>/...
+    src/<class_name>/*.{jpg,...}                  (+ val_fraction split)
+
+Class names map to label indices in sorted order; the mapping is
+recorded in ``meta.json`` (``class_names``) for inference-time reverse
+lookup. PIL is an optional dependency: importers raise a clear error if
+it is missing (the npy path itself never needs it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Sequence
+
+import numpy as np
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".gif")
+
+
+def _require_pil():
+    try:
+        from PIL import Image  # noqa: F401
+
+        return Image
+    except ImportError as e:  # pragma: no cover - PIL is installed here
+        raise ImportError(
+            "image-directory import needs PIL (pillow); install it or "
+            "convert to the npy format by other means (data/filedata.py "
+            "documents the layout)"
+        ) from e
+
+
+def decode_image(path: str, size: int) -> np.ndarray:
+    """One file → uint8 [size, size, 3]: RGB decode, shorter-side resize
+    to ``size`` (bilinear), center crop."""
+    Image = _require_pil()
+    with Image.open(path) as im:
+        im = im.convert("RGB")
+        w, h = im.size
+        s = size / min(w, h)
+        rw, rh = max(size, int(round(w * s))), max(size, int(round(h * s)))
+        im = im.resize((rw, rh), Image.BILINEAR)
+        x, y = (rw - size) // 2, (rh - size) // 2
+        im = im.crop((x, y, x + size, y + size))
+        return np.asarray(im, dtype=np.uint8)
+
+
+def _class_dirs(root: str) -> list[str]:
+    return sorted(
+        d
+        for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d)) and not d.startswith(".")
+    )
+
+
+def _image_files(class_dir: str) -> list[str]:
+    return sorted(
+        os.path.join(class_dir, f)
+        for f in os.listdir(class_dir)
+        if f.lower().endswith(_EXTS)
+    )
+
+
+def _decode_split(
+    root: str, class_names: Sequence[str], size: int
+) -> tuple[np.ndarray, np.ndarray]:
+    images, labels = [], []
+    for idx, name in enumerate(class_names):
+        for path in _image_files(os.path.join(root, name)):
+            images.append(decode_image(path, size))
+            labels.append(idx)
+    if not images:
+        raise ValueError(f"{root}: no decodable images found")
+    return np.stack(images), np.asarray(labels, np.int32)
+
+
+def import_image_directory(
+    src_dir: str,
+    out_dir: str,
+    *,
+    size: int = 256,
+    val_fraction: float = 0.0,
+    seed: int = 0,
+) -> str:
+    """Convert an image directory tree to the npy dataset at ``out_dir``.
+
+    With ``src/train/`` + ``src/val/`` subtrees, each becomes the
+    matching split. Otherwise ``src/<class>/...`` is treated as train,
+    and ``val_fraction > 0`` carves a per-class deterministic holdout.
+    Returns ``out_dir`` (loadable via ``load_dataset`` /
+    ``FileClassification``).
+    """
+    from mpit_tpu.data.filedata import write_classification
+
+    train_root = os.path.join(src_dir, "train")
+    val_root = os.path.join(src_dir, "val")
+    has_splits = os.path.isdir(train_root)
+    if not has_splits:
+        train_root, val_root = src_dir, ""
+
+    class_names = _class_dirs(train_root)
+    if not class_names:
+        raise ValueError(f"{train_root}: no class subdirectories")
+    images, labels = _decode_split(train_root, class_names, size)
+
+    if has_splits and os.path.isdir(val_root):
+        vimages, vlabels = _decode_split(val_root, class_names, size)
+    elif val_fraction > 0.0:
+        rng = np.random.RandomState(seed)
+        val_mask = np.zeros(len(labels), bool)
+        for c in range(len(class_names)):
+            idx = np.flatnonzero(labels == c)
+            n_val = max(1, int(round(len(idx) * val_fraction)))
+            val_mask[rng.permutation(idx)[:n_val]] = True
+        vimages, vlabels = images[val_mask], labels[val_mask]
+        images, labels = images[~val_mask], labels[~val_mask]
+    else:
+        vimages = None
+
+    write_classification(
+        out_dir, images, labels, num_classes=len(class_names)
+    )
+    if vimages is not None and len(vimages):
+        write_classification(
+            out_dir, vimages, vlabels, split="val",
+            num_classes=len(class_names),
+        )
+    # Record the class-name ↔ index mapping for reverse lookup.
+    meta_path = os.path.join(out_dir, "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["class_names"] = list(class_names)
+    tmp = meta_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=1)
+    os.replace(tmp, meta_path)
+    return out_dir

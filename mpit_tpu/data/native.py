@@ -107,6 +107,13 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.mpit_lm_release_slot.argtypes = [c.c_void_p, c.c_int]
     lib.mpit_lm_destroy.argtypes = [c.c_void_p]
 
+    lib.mpit_rrc_batch.argtypes = [
+        c.POINTER(c.c_float), c.POINTER(c.c_float),
+        c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+        c.c_uint64, c.c_uint64,
+        c.c_float, c.c_float, c.c_float, c.c_float, c.c_int,
+    ]
+
 
 def available() -> bool:
     """Whether the native core can be (or was) built and loaded."""
@@ -290,3 +297,44 @@ def lm_stream(
         lib, handle, lib.mpit_lm_next_slot, lib.mpit_lm_release_slot,
         lib.mpit_lm_destroy, views, copy,
     )
+
+
+def rrc_batch(
+    images: np.ndarray,
+    *,
+    seed: int,
+    ticket: int,
+    out_hw: tuple[int, int] | None = None,
+    scale: tuple[float, float] = (0.08, 1.0),
+    ratio: tuple[float, float] = (3 / 4, 4 / 3),
+    hflip: bool = True,
+) -> np.ndarray | None:
+    """Native random-resized-crop of one ``[B, H, W, C]`` float32 batch.
+
+    The C++ counterpart of ``data/augment.py::random_resized_crop`` for
+    the file-backed (real-image) pipeline: same sampling scheme and
+    counter-seeding shape (one ``(seed, ticket)`` stream per batch), the
+    established bit-different / distribution-identical native contract,
+    and the per-pixel bilinear loop runs off the GIL. Returns None when
+    the native build is unavailable (caller falls back to numpy).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    images = np.ascontiguousarray(images, np.float32)
+    if images.ndim != 4:
+        raise ValueError(f"expected [B,H,W,C] images, got {images.shape}")
+    b, h, w, c = images.shape
+    oh, ow = out_hw if out_hw is not None else (h, w)
+    out = np.empty((b, oh, ow, c), np.float32)
+    lib.mpit_rrc_batch(
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        b, h, w, c, oh, ow,
+        ctypes.c_uint64(seed & (2**64 - 1)),
+        ctypes.c_uint64(ticket & (2**64 - 1)),
+        float(scale[0]), float(scale[1]),
+        float(ratio[0]), float(ratio[1]),
+        1 if hflip else 0,
+    )
+    return out

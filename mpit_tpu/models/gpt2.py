@@ -56,6 +56,13 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
     attention_fn: AttentionFn = default_attention
     remat: bool = False  # jax.checkpoint each block (HBM for FLOPs)
+    # LayerNorm OUTPUT dtype; None = follow ``dtype``. Statistics always
+    # accumulate in f32 (flax upcasts internally); the historical
+    # hard-coded f32 output made every bf16 block bounce activations
+    # f32->bf16 around both LNs — measured ~15 ms/step of convert/copy
+    # fusions at B=48/T=512 (round-4 trace, BENCHMARKS.md). f32 configs
+    # (parity tests) stay exactly f32 via the follow-``dtype`` default.
+    ln_dtype: Any = None
     # LM-head matmul operand dtype. The [T, d_model] x [vocab, d_model]
     # logits einsum is the single biggest matmul in the model; bf16
     # operands with f32 accumulation run it at full MXU rate. f32 default
@@ -66,6 +73,11 @@ class GPT2Config:
     # stage 0 while a tied head's would live on every stage, and the two
     # contributions cannot be combined per-leaf after AD.
     tie_head: bool = True
+
+    @property
+    def ln_out_dtype(self):
+        """Resolved LayerNorm output dtype (see ``ln_dtype``)."""
+        return self.dtype if self.ln_dtype is None else self.ln_dtype
 
     @property
     def head_dim(self) -> int:
@@ -96,7 +108,7 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        h = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln1")(x)
         qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda t: t.reshape(*t.shape[:-1], cfg.num_heads, cfg.head_dim)
@@ -104,7 +116,7 @@ class Block(nn.Module):
         attn = attn.reshape(*attn.shape[:-2], cfg.d_model)
         x = x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="proj")(attn)
 
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln2")(x)
         h = nn.Dense(cfg.ff_dim, dtype=cfg.dtype, name="fc")(h)
         h = nn.gelu(h)
         x = x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="out")(h)
@@ -150,7 +162,7 @@ class GPT2(nn.Module):
             block = nn.remat(Block)
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"block_{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln_f")(x)
         # LM head (f32 accumulation regardless of operand dtype); tied to
         # wte by default, separate under tie_head=False (see GPT2Config).
         head = (

@@ -52,7 +52,7 @@ class MoEBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg, moe = self.cfg, self.moe
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        h = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln1")(x)
         qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda t: t.reshape(*t.shape[:-1], cfg.num_heads, cfg.head_dim)
@@ -60,7 +60,7 @@ class MoEBlock(nn.Module):
         attn = attn.reshape(*attn.shape[:-2], cfg.d_model)
         x = x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="proj")(attn)
 
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln2")(x)
         d, e = cfg.d_model, moe.num_experts
         f = moe.d_ff or cfg.ff_dim
         if e % moe.shards:
@@ -81,14 +81,20 @@ class MoEBlock(nn.Module):
             ),
             "b_out": self.param("b_out", nn.initializers.zeros, (el, d)),
         }
-        y, aux = expert_parallel_moe(
+        y, aux, stats = expert_parallel_moe(
             h.astype(cfg.dtype),
             params,
             k=moe.k,
             capacity_factor=moe.capacity_factor,
             axis=moe.axis_name,
             reduce_aux=moe.reduce_aux,
+            with_stats=True,
         )
+        # Routing observability (bench/eval read it via
+        # ``apply(..., mutable=["intermediates"])``; dead-code-eliminated
+        # in the training step, which never requests the collection).
+        self.sow("intermediates", "drop_rate", stats["drop_rate"])
+        self.sow("intermediates", "expert_load", stats["expert_load"])
         return x + y, aux
 
 
@@ -135,7 +141,7 @@ class GPT2MoE(nn.Module):
                 aux = aux + a
             else:
                 x = dense_block(cfg, name=f"block_{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln_f")(x)
         head = (
             wte
             if cfg.tie_head

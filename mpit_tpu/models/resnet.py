@@ -9,6 +9,21 @@ the train step syncs them with a ``pmean`` when cross-replica BN is enabled
 (the sync-DP semantics of config #4 concern gradients; BN sync is optional
 as in most data-parallel trainers). bfloat16 compute, float32 params and
 BN stats.
+
+Round-4 perf levers (the standard TPU ResNet recipe; measured in
+BENCHMARKS.md):
+
+- ``norm_dtype=bfloat16`` (default): BN *statistics* still accumulate in
+  float32 (flax upcasts internally) but the normalized activations stay
+  bf16 — without this every BN+relu bounces activations through f32,
+  doubling the HBM traffic of every block's elementwise tail.
+- ``stem="s2d"`` (default): 2×2 space-to-depth on the input
+  ([B,224,224,3] → [B,112,112,12]) + a 4×4/stride-1 conv replacing the
+  7×7/stride-2 stem. Same receptive field (7 taps at stride 2 span 8
+  pixels = 4 s2d cells), 12 input channels instead of 3 (less MXU
+  contraction-dim padding), and a stride-1 conv the TPU convolution
+  tiling prefers. Trained from scratch this is a reparameterization,
+  not a pretrained-weight transform.
 """
 
 from __future__ import annotations
@@ -25,11 +40,12 @@ class Bottleneck(nn.Module):
     strides: int = 1
     dtype: Any = jnp.bfloat16
     norm: Any = nn.BatchNorm
+    norm_dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
         norm = partial(
-            self.norm, use_running_average=not train, dtype=jnp.float32
+            self.norm, use_running_average=not train, dtype=self.norm_dtype
         )
         residual = x
         y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
@@ -54,6 +70,8 @@ class ResNet50(nn.Module):
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    norm_dtype: Any = jnp.bfloat16
+    stem: str = "s2d"  # "s2d" (TPU recipe) | "conv7" (classic)
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -62,20 +80,38 @@ class ResNet50(nn.Module):
         ``train=False``: BN normalizes with the running averages — the
         inference-mode path eval metrics must use (round-1 advisor)."""
         x = x.astype(self.dtype)
-        x = nn.Conv(
-            64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-            use_bias=False, dtype=self.dtype,
-        )(x)
+        if self.stem == "s2d":
+            b, h, w, c = x.shape
+            if h % 2 or w % 2:
+                raise ValueError(
+                    f"stem='s2d' needs even input H/W (got {h}x{w}); use "
+                    "an even --train-size or stem='conv7'"
+                )
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c).transpose(
+                0, 1, 3, 2, 4, 5
+            ).reshape(b, h // 2, w // 2, 4 * c)
+            x = nn.Conv(
+                64, (4, 4), strides=(1, 1), padding=[(1, 2), (1, 2)],
+                use_bias=False, dtype=self.dtype,
+            )(x)
+        else:
+            x = nn.Conv(
+                64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                use_bias=False, dtype=self.dtype,
+            )(x)
         x = nn.relu(
-            nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(x)
+            nn.BatchNorm(
+                use_running_average=not train, dtype=self.norm_dtype
+            )(x)
         )
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         for stage, n_blocks in enumerate(self.stage_sizes):
             for block in range(n_blocks):
                 strides = 2 if stage > 0 and block == 0 else 1
                 x = Bottleneck(
-                    64 * 2**stage, strides=strides, dtype=self.dtype
+                    64 * 2**stage, strides=strides, dtype=self.dtype,
+                    norm_dtype=self.norm_dtype,
                 )(x, train=train)
-        x = jnp.mean(x, axis=(1, 2))
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
         return x

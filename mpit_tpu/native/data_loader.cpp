@@ -23,6 +23,7 @@
 // Not bit-identical to the numpy reference path (different generator);
 // the parity tests check distributional properties, not bytes.
 
+#include <algorithm>
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
@@ -376,5 +377,87 @@ void mpit_lm_release_slot(void* h, int slot) {
   static_cast<LmLoader*>(h)->ring.release(slot);
 }
 void mpit_lm_destroy(void* h) { delete static_cast<LmLoader*>(h); }
+
+// ---- batch augmentation (file-backed pipelines) ---------------------------
+//
+// Random-resized-crop + hflip of one already-assembled batch: the native
+// counterpart of data/augment.py::random_resized_crop, for the real-image
+// path where decoding/assembly is mmap'd numpy but the per-pixel bilinear
+// resample is the hot loop. Counter-seeded the same way as the loaders
+// ((seed, ticket) -> its own stream), so resume replays exactly; the
+// sampling scheme mirrors the Python one (up to 10 area/aspect rejection
+// attempts, clamped-center fallback) with the established bit-different /
+// distribution-identical native contract. Runs off the GIL (ctypes).
+void mpit_rrc_batch(const float* in, float* out, int b, int h, int w, int c,
+                    int oh, int ow, uint64_t seed, uint64_t ticket,
+                    float smin, float smax, float rmin, float rmax,
+                    int hflip) {
+  Xoshiro rng = ticket_rng(seed, ticket);
+  const double log_rmin = std::log(static_cast<double>(rmin));
+  const double log_rmax = std::log(static_cast<double>(rmax));
+  for (int i = 0; i < b; ++i) {
+    const float* img = in + static_cast<size_t>(i) * h * w * c;
+    float* dst = out + static_cast<size_t>(i) * oh * ow * c;
+    // -- sample the crop box (torchvision-convention rejection loop) --
+    int cy = 0, cx = 0, ch = h, cw = w;
+    bool found = false;
+    const double area = static_cast<double>(h) * w;
+    for (int attempt = 0; attempt < 10 && !found; ++attempt) {
+      const double target = area * (smin + (smax - smin) * rng.uniform());
+      const double r = std::exp(log_rmin + (log_rmax - log_rmin) * rng.uniform());
+      const int tw = static_cast<int>(std::lround(std::sqrt(target * r)));
+      const int th = static_cast<int>(std::lround(std::sqrt(target / r)));
+      if (tw > 0 && tw <= w && th > 0 && th <= h) {
+        cy = th < h ? static_cast<int>(rng.below(h - th + 1)) : 0;
+        cx = tw < w ? static_cast<int>(rng.below(w - tw + 1)) : 0;
+        ch = th;
+        cw = tw;
+        found = true;
+      }
+    }
+    if (!found) {  // clamped-aspect center fallback
+      const double in_r = static_cast<double>(w) / h;
+      if (in_r < rmin) {
+        cw = w;
+        ch = std::min(h, static_cast<int>(std::lround(w / rmin)));
+      } else if (in_r > rmax) {
+        ch = h;
+        cw = std::min(w, static_cast<int>(std::lround(h * rmax)));
+      } else {
+        ch = h;
+        cw = w;
+      }
+      cy = (h - ch) / 2;
+      cx = (w - cw) / 2;
+    }
+    const bool flip = hflip && (rng.next() & 1);
+    // -- bilinear resample crop -> [oh, ow] (align-corners=false) --
+    for (int y = 0; y < oh; ++y) {
+      const float fy = (y + 0.5f) * (static_cast<float>(ch) / oh) - 0.5f;
+      int y0 = static_cast<int>(std::floor(fy));
+      float wy = fy - y0;
+      if (y0 < 0) { y0 = 0; wy = 0.0f; }
+      if (y0 > ch - 1) y0 = ch - 1;
+      const int y1 = std::min(y0 + 1, ch - 1);
+      const float* row0 = img + (static_cast<size_t>(cy + y0) * w + cx) * c;
+      const float* row1 = img + (static_cast<size_t>(cy + y1) * w + cx) * c;
+      for (int x = 0; x < ow; ++x) {
+        const int xo = flip ? ow - 1 - x : x;
+        const float fx = (x + 0.5f) * (static_cast<float>(cw) / ow) - 0.5f;
+        int x0 = static_cast<int>(std::floor(fx));
+        float wx = fx - x0;
+        if (x0 < 0) { x0 = 0; wx = 0.0f; }
+        if (x0 > cw - 1) x0 = cw - 1;
+        const int x1 = std::min(x0 + 1, cw - 1);
+        float* o = dst + (static_cast<size_t>(y) * ow + xo) * c;
+        for (int k = 0; k < c; ++k) {
+          const float top = row0[x0 * c + k] * (1 - wx) + row0[x1 * c + k] * wx;
+          const float bot = row1[x0 * c + k] * (1 - wx) + row1[x1 * c + k] * wx;
+          o[k] = top * (1 - wy) + bot * wy;
+        }
+      }
+    }
+  }
+}
 
 }  // extern "C"

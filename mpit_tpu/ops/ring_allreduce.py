@@ -60,6 +60,7 @@ def _kernel(
     *,
     axis: str,
     num_devices: int,
+    interpret: bool,
 ):
     p = num_devices
     i = lax.axis_index(axis)
@@ -105,7 +106,12 @@ def _kernel(
         # in recv_buf[g%2]. send_buf is never a remote-write target, so no
         # neighbor progress can corrupt a send in flight.
         rdma.wait()
-        incoming = _pvary(recv_buf[g % 2], (axis,))
+        # _pvary feeds the interpret-mode VMA checker only; the real TPU
+        # Mosaic lowering has no VMA tracking and rejects the primitive
+        # (caught by the v5e-8 AOT compile check, utils/aot.py).
+        incoming = recv_buf[g % 2]
+        if interpret:
+            incoming = _pvary(incoming, (axis,))
         if accumulate:
             o_ref[pl.ds(recv_c * rows, rows), :] += incoming
         else:
@@ -146,7 +152,9 @@ def _kernel(
 
 def _ring_allreduce_2d(x2d, *, axis: str, interpret: bool):
     p = lax.axis_size(axis)
-    kern = functools.partial(_kernel, axis=axis, num_devices=p)
+    kern = functools.partial(
+        _kernel, axis=axis, num_devices=p, interpret=interpret
+    )
     rows = x2d.shape[0] // p
     return pl.pallas_call(
         kern,

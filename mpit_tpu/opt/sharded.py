@@ -14,9 +14,36 @@ all-gather IS a ring allreduce, split around the update) while dividing
 optimizer memory by N.
 
 Like the reference's flat-tensor design (Torch's flattened parameters), the
-pytree is raveled to one 1-D vector, padded to a multiple of the axis size,
-and sharded contiguously. The update rule is elementwise, so flat layout
-costs nothing on the MXU and keeps shard boundaries trivial.
+pytree is raveled to one 1-D vector, padded to a multiple of
+``axis_size * LANE``, and sharded contiguously. The update rule is
+elementwise, so flat layout costs nothing on the MXU and keeps shard
+boundaries trivial.
+
+TILE-FRIENDLY FLAT LAYOUT (round-4 fix, verified by the v5e-8 AOT
+compile check ``compile_multichip.py``): the 322M-param MoE model
+compile-OOMed in round 3 because the TPU compiler materialised a
+``f32[total/8, 8]`` view of the flat vector, which the layout pass
+tile-pads 16× (20.6 GB on a 16 GB chip). Two structural causes, two
+rules:
+
+1. **Collectives see ``[rows, LANE]``, never 1-D.** A scatter/gather on
+   a flat ``[total]`` makes the lowering reshape ``[total/n, n]`` —
+   minor dim = axis size, tile-padded ``LANE/n``×. The 2-D lane view
+   keeps the internal reshape at ``[n, rows/n, LANE]`` — zero pad.
+2. **Every leaf starts at a LANE-aligned offset** (:func:`flat_ravel`,
+   replacing ``ravel_pytree``). The stock unravel (``jnp.split`` at
+   arbitrary offsets) made XLA extract a ``[768, 8]`` router leaf by
+   reshaping the WHOLE flat vector to ``[total/8, 8]`` (minor dim = the
+   leaf's own trailing dim) — the exact 20.6 GB allocation, reachable
+   from any weirdly-shaped leaf. With per-leaf padding to a LANE
+   multiple, every leaf extraction is whole rows of the ``[rows, LANE]``
+   view: slice + reshape, no narrow intermediate. Alignment waste is
+   < LANE elements per leaf — noise.
+
+The per-device state stays a 1-D ``[padded_total/n]`` vector;
+``train/convert.py`` imports the same :func:`flat_ravel`/:func:`shard_of`
+choreography, so checkpoints and conversions can never drift from the
+update path.
 
 All functions here run *inside* ``shard_map`` (state is per-device = truly
 sharded). :func:`sharded_init`/:func:`sharded_update` are host-level
@@ -29,12 +56,18 @@ from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
-from jax.flatten_util import ravel_pytree
 from jax.sharding import PartitionSpec as P
 
 from mpit_tpu.comm import collectives as C
+
+
+# TPU vector lane width: the minor dim of every tile is 128 wide for f32.
+# Collectives are fed [rows, LANE] views (see module docstring) so the SPMD
+# lowering's internal reshape never creates a narrow, tile-padded minor dim.
+LANE = 128
 
 
 def _pad_to(x: jax.Array, multiple: int) -> jax.Array:
@@ -44,13 +77,81 @@ def _pad_to(x: jax.Array, multiple: int) -> jax.Array:
     return x
 
 
+def padded_len(size: int, n: int) -> int:
+    """Length of the flat vector after padding for an ``n``-way shard: the
+    single source of truth for the ZeRO-1 pad multiple (``n * LANE``)."""
+    return size + ((-size) % (n * LANE))
+
+
+def _leaf_padded(size: int) -> int:
+    return size + ((-size) % LANE)
+
+
+def flat_len(tree) -> int:
+    """Length of :func:`flat_ravel`'s output for ``tree`` (sum of
+    per-leaf LANE-padded sizes) — computable from shapes alone."""
+    return sum(
+        _leaf_padded(int(np.prod(l.shape)) if l.shape else 1)
+        for l in jax.tree.leaves(tree)
+    )
+
+
+def flat_ravel(tree):
+    """Lane-aligned ``ravel_pytree`` (module docstring rule 2): each leaf
+    is raveled and zero-padded to a LANE multiple before concatenation, so
+    every leaf lives at a LANE-aligned offset of the flat vector and the
+    unravel is whole-row slice+reshape on the ``[rows, LANE]`` view.
+
+    Returns ``(flat, unravel)`` like ``ravel_pytree``; the elementwise goo
+    family is indifferent to the interleaved zero padding (padded slots
+    carry zero grads, so their state stays zero). THE single flat-layout
+    authority — ``train/convert.py`` imports it for conversions.
+
+    Every per-leaf slice/ravel is fenced with ``optimization_barrier``:
+    XLA's algebraic simplifier otherwise canonicalises a leaf extraction
+    ``reshape(slice(flat), leaf_shape)`` into ``slice(reshape(flat,
+    [total/k, k]))`` with the leaf's own trailing dim as the minor dim —
+    and for a narrow leaf (the MoE router's ``[768, 8]``) the TPU layout
+    pass tile-pads that whole-vector intermediate ``LANE/k``×: the
+    measured 20.6 GB round-3 compile-OOM at 322M params. The barrier
+    pins the rewrite at the leaf boundary, where the worst
+    materialisation is the leaf itself. (Found and verified with the
+    v5e-8 AOT compile check, ``compile_multichip.py``.)
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    parts = []
+    for leaf in leaves:
+        flat = lax.optimization_barrier(jnp.ravel(leaf))
+        pad = (-flat.shape[0]) % LANE
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        parts.append(flat)
+    flat_all = (
+        jnp.concatenate(parts) if parts else jnp.zeros((0,), jnp.float32)
+    )
+
+    def unravel(v):
+        out, off = [], 0
+        for leaf in leaves:
+            size = int(np.prod(leaf.shape)) if leaf.shape else 1
+            seg = lax.optimization_barrier(
+                lax.slice(v, (off,), (off + size,))
+            )
+            out.append(seg.reshape(leaf.shape).astype(leaf.dtype))
+            off += _leaf_padded(size)
+        return jax.tree.unflatten(treedef, out)
+
+    return flat_all, unravel
+
+
 def shard_of(flat: jax.Array, axis: str) -> jax.Array:
-    """This device's contiguous shard of a flat vector (pad to the axis
-    size, slice by axis index) — THE shard choreography every ZeRO-1
-    layout shares; ``train/convert.py``'s cross-tier conversion imports
-    it so checkpoint conversion can never drift from the update path."""
+    """This device's contiguous shard of a flat vector (pad to
+    ``axis_size * LANE``, slice by axis index) — THE shard choreography
+    every ZeRO-1 layout shares; ``train/convert.py``'s cross-tier
+    conversion imports it so checkpoint conversion can never drift from
+    the update path."""
     n = lax.axis_size(axis)
-    padded = _pad_to(flat, n)
+    padded = _pad_to(flat, n * LANE)
     s = padded.shape[0] // n
     return lax.dynamic_slice(padded, (lax.axis_index(axis) * s,), (s,))
 
@@ -88,25 +189,38 @@ def sharded(
     """
 
     def init(params):
-        flat, _ = ravel_pytree(params)
+        flat, _ = flat_ravel(params)
         return tx.init(shard_of(flat, axis))
 
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("sharded(tx) requires params")
         n = lax.axis_size(axis)
-        flat_g, unravel = ravel_pytree(grads)
+        flat_g, unravel = flat_ravel(grads)
         size = flat_g.shape[0]
         # reduce-scatter: each device receives the summed shard it owns.
-        g_shard = C.reduce_scatter(_pad_to(flat_g, n), axis)
+        # [rows, LANE] view keeps the lowering's minor dim lane-aligned
+        # (see module docstring: the 1-D form tile-pads 16x at 300M+).
+        g2 = _pad_to(flat_g, n * LANE).reshape(-1, LANE)
+        g_shard = C.reduce_scatter(g2, axis).reshape(-1)
         if mean_grads:
             g_shard = g_shard / n
-        flat_p, _ = ravel_pytree(params)
+        flat_p, _ = flat_ravel(params)
         p_shard = shard_of(flat_p, axis)
         u_shard, new_state = tx.update(g_shard, state, p_shard)
         # invariant gather: updates are identical everywhere and typed
         # replicated, so they can exit shard_map with a replicated spec.
-        flat_u = C.allgather(u_shard, axis, tiled=True, invariant=True)[:size]
+        flat_u = C.allgather(
+            u_shard.reshape(-1, LANE), axis, tiled=True, invariant=True
+        ).reshape(-1)[:size]
+        # Barrier before unravel: without it, XLA's algebraic simplifier
+        # rewrites a leaf extraction (1-D slice + reshape to e.g. the MoE
+        # router's [768, 8]) into a reshape of the WHOLE flat vector to
+        # [total/8, 8], whose 8-wide minor dim the TPU layout pass
+        # tile-pads 16x — a 20.6 GB allocation at 322M params (the round-3
+        # compile-OOM, reproduced and fixed via the v5e-8 AOT check).
+        # Materializing the 1-D flat vector here costs its plain size once.
+        flat_u = lax.optimization_barrier(flat_u)
         return unravel(flat_u), new_state
 
     return optax.GradientTransformation(init, update)
@@ -144,9 +258,9 @@ def state_partition_specs(
     """
 
     def one_device_init(p):
-        flat, _ = ravel_pytree(p)
-        padded_len = flat.shape[0] + ((-flat.shape[0]) % n)
-        return tx.init(jnp.zeros((padded_len // n,), flat.dtype))
+        leaves = jax.tree.leaves(p)
+        dtype = jnp.result_type(*(l.dtype for l in leaves)) if leaves else jnp.float32
+        return tx.init(jnp.zeros((padded_len(flat_len(p), n) // n,), dtype))
 
     shapes = jax.eval_shape(one_device_init, params)
     return jax.tree.map(

@@ -99,6 +99,7 @@ def expert_parallel_moe(
     capacity_factor: float = 1.25,
     axis: str | None = None,
     reduce_aux: bool = True,
+    with_stats: bool = False,
 ):
     """Routed MoE MLP; with ``axis`` set, experts are sharded over that mesh
     axis (call inside ``shard_map``; ``w_in``/``b_in``/``w_out``/``b_out``
@@ -110,7 +111,9 @@ def expert_parallel_moe(
     Returns ``(out, aux_loss)`` with out shaped like x. ``reduce_aux=False``
     returns the LOCAL (this device's tokens) aux value instead of the
     axis-pmean — the EP training tier sums it into its globally-normalized
-    objective itself (``parallel.ep``).
+    objective itself (``parallel.ep``). ``with_stats=True`` appends
+    :func:`dispatch_stats` of the local routing decision (observability;
+    XLA dead-code-eliminates it when the caller drops it).
     """
     orig_shape = x.shape
     d = x.shape[-1]
@@ -153,7 +156,10 @@ def expert_parallel_moe(
     if axis is not None and reduce_aux:
         aux = lax.pmean(aux, axis)
 
-    return out.reshape(orig_shape).astype(x.dtype), aux
+    result = out.reshape(orig_shape).astype(x.dtype)
+    if with_stats:
+        return result, aux, dispatch_stats(dispatch, k)
+    return result, aux
 
 
 class MoEMLP(nn.Module):

@@ -454,20 +454,25 @@ def make_gpt2_pp_train_step(
 
     compiled: dict = {}
 
+    def build(params):
+        specs = state_specs(params)
+        return jax.jit(
+            world.shard_map(
+                _per_device_step,
+                in_specs=(specs, P(data_axis)),
+                out_specs=(specs, P()),
+            ),
+            donate_argnums=(0,) if donate else (),
+        )
+
     def step_fn(state: TrainState, batch):
         key = jax.tree_util.tree_structure(state.params)
         f = compiled.get(key)
         if f is None:
-            specs = state_specs(state.params)
-            f = jax.jit(
-                world.shard_map(
-                    _per_device_step,
-                    in_specs=(specs, P(data_axis)),
-                    out_specs=(specs, P()),
-                ),
-                donate_argnums=(0,) if donate else (),
-            )
+            f = build(state.params)
             compiled[key] = f
         return f(state, batch)
 
+    # AOT seam for utils/aot.py compile_multichip.
+    step_fn.build = build
     return init_fn, step_fn, state_specs

@@ -201,6 +201,20 @@ def make_pjit_train_step(
 
     compiled: dict = {}
 
+    def build(params, batch):
+        shardings = shardings_fn(params)
+        # Pure-TP mesh (no data axis): batch replicates.
+        batch_spec = P(data_axis) if data_axis in mesh.axis_names else P()
+        batch_sh = jax.tree.map(
+            lambda _: NamedSharding(mesh, batch_spec), batch
+        )
+        return jax.jit(
+            _step,
+            in_shardings=(shardings, batch_sh),
+            out_shardings=(shardings, NamedSharding(mesh, P())),
+            donate_argnums=(0,) if donate else (),
+        )
+
     def step_fn(state: TrainState, batch):
         key = (
             jax.tree_util.tree_structure((state, batch)),
@@ -210,19 +224,10 @@ def make_pjit_train_step(
         )
         f = compiled.get(key)
         if f is None:
-            shardings = shardings_fn(state.params)
-            # Pure-TP mesh (no data axis): batch replicates.
-            batch_spec = P(data_axis) if data_axis in mesh.axis_names else P()
-            batch_sh = jax.tree.map(
-                lambda _: NamedSharding(mesh, batch_spec), batch
-            )
-            f = jax.jit(
-                _step,
-                in_shardings=(shardings, batch_sh),
-                out_shardings=(shardings, NamedSharding(mesh, P())),
-                donate_argnums=(0,) if donate else (),
-            )
+            f = build(state.params, batch)
             compiled[key] = f
         return f(state, batch)
 
+    # AOT seam for utils/aot.py compile_multichip.
+    step_fn.build = build
     return init_fn, step_fn, shardings_fn

@@ -26,7 +26,9 @@ from mpit_tpu.train.convert import (
     dense_from_dp,
     dense_from_pp,
     dp_from_dense,
+    load_dense,
     pp_from_dense,
+    save_dense,
     threed_from_dense,
 )
 from mpit_tpu.train.metrics import MetricLogger, Throughput

@@ -87,6 +87,18 @@ class CheckpointManager:
                     "(e.g. --schedule-horizon pins the decay length across "
                     "runs with different --steps) or use a fresh --ckpt-dir"
                 )
+            # Validation passed. Geometry fields this framework version
+            # added but the recorded meta predates were skipped above —
+            # merge them in (process 0) so subsequent resumes validate the
+            # full field set instead of leaving them unvalidated forever
+            # (round-3 advisor finding).
+            unrecorded = {k: v for k, v in meta.items() if k not in recorded}
+            if unrecorded and jax.process_index() == 0:
+                merged = {**recorded, **unrecorded}
+                tmp = path.with_suffix(".json.tmp")
+                with open(tmp, "w") as f:
+                    json.dump(merged, f, indent=1)
+                os.replace(tmp, path)
             return
         if not path.exists() and self.latest_step() is not None:
             # Pre-upgrade directory (checkpoint written before run-meta

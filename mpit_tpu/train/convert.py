@@ -32,6 +32,14 @@ vector leaves — the ``opt/sharded.py`` precondition); scalar state
 leaves (adam's count) ride along replicated. Conversion runs at
 host-level (gather to numpy, re-place with the target tier's specs) —
 it is an offline checkpoint operation, not a training-step path.
+
+**Single-controller requirement:** the ``dense_from_*`` directions
+gather global arrays with ``np.asarray``, which needs every shard
+addressable from this process. On a multi-host pod run the conversion
+must happen in a separate single-process job over the checkpoint files
+(or via ``jax.experimental.multihost_utils.process_allgather``); the
+entry points enforce this with a clear error instead of the opaque
+"array is not fully addressable" failure (round-3 advisor finding).
 """
 
 from __future__ import annotations
@@ -44,7 +52,6 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax import lax
-from jax.flatten_util import ravel_pytree
 from jax.sharding import PartitionSpec as P
 
 from mpit_tpu.train.step import TrainState
@@ -64,8 +71,23 @@ def _is_vec(leaf) -> bool:
     return getattr(leaf, "ndim", 0) >= 1
 
 
-# THE shard choreography (single source of truth with the update path:
-# a drift here would silently misalign converted moment shards).
+def _require_single_controller(op: str) -> None:
+    """See module docstring: dense gathers need fully-addressable arrays."""
+    if jax.process_count() > 1:
+        raise RuntimeError(
+            f"{op} gathers global arrays to host numpy and requires a "
+            "single-controller (1-process) runtime; this is a "
+            f"{jax.process_count()}-process run. Convert offline in a "
+            "single-process job over the checkpoint, or gather with "
+            "jax.experimental.multihost_utils.process_allgather first."
+        )
+
+
+# THE shard + flat-layout choreography (single source of truth with the
+# update path: a drift here would silently misalign converted moment
+# shards). flat_ravel is the lane-aligned ravel_pytree replacement —
+# opt/sharded.py module docstring rule 2.
+from mpit_tpu.opt.sharded import flat_ravel as _flat_ravel
 from mpit_tpu.opt.sharded import shard_of as _shard_of_1d
 
 
@@ -107,9 +129,9 @@ def _group_state(tx, scalars, data_axis, p_group, m_groups):
     device's param shard, vector leaves replaced by the same shard of
     each converted moment. Shared by every ``*_from_dense`` direction —
     the shard slice must never fork per tier (module docstring)."""
-    flat_p, _ = ravel_pytree(p_group)
+    flat_p, _ = _flat_ravel(p_group)
     template = tx.init(_shard_of(flat_p, data_axis))
-    shards = [_shard_of(ravel_pytree(m)[0], data_axis) for m in m_groups]
+    shards = [_shard_of(_flat_ravel(m)[0], data_axis) for m in m_groups]
     return _fill_state(template, shards, scalars)
 
 
@@ -119,13 +141,25 @@ def _gather_group(data_axis, p_group, sub_state):
     Shared by every ``dense_from_*`` direction."""
     from mpit_tpu.comm import collectives as C
 
-    flat_p, unravel = ravel_pytree(p_group)
+    _, unravel = _flat_ravel(p_group)
     vecs, _ = _moment_vectors(sub_state)
+    # [rows, LANE] view for the gather: keeps the TPU lowering's minor dim
+    # lane-aligned (opt/sharded.py module docstring — the 1-D form
+    # tile-pads 16x at 300M+ params). Shard lengths are LANE multiples by
+    # construction, so the reshape is always valid; flat_ravel's unravel
+    # slices within the gathered (>= flat_len) vector, no trim needed.
+    from mpit_tpu.opt.sharded import LANE
+
     return [
         unravel(
-            C.allgather(v, data_axis, tiled=True, invariant=True)[
-                : flat_p.shape[0]
-            ]
+            # Barrier: see opt/sharded.py update() — stops XLA rewriting
+            # the per-leaf extraction into a tile-padded whole-vector
+            # [total/8, 8] reshape.
+            lax.optimization_barrier(
+                C.allgather(
+                    v.reshape(-1, LANE), data_axis, tiled=True, invariant=True
+                ).reshape(-1)
+            )
         )
         for v in vecs
     ]
@@ -143,11 +177,12 @@ def dense_from_dp(state: TrainState) -> DenseState:
     indexing them gathers the full padded flat vector, which unravels
     with the dense params' own unraveler.
     """
+    _require_single_controller("dense_from_dp")
     params = jax.tree.map(np.asarray, state.params)
-    flat, unravel = ravel_pytree(params)
+    _, unravel = _flat_ravel(params)
     vecs, scalars = _moment_vectors(state.opt_state)
     moments = [
-        jax.tree.map(np.asarray, unravel(jnp.asarray(v).ravel()[: flat.shape[0]]))
+        jax.tree.map(np.asarray, unravel(jnp.asarray(v).ravel()))
         for v in vecs
     ]
     return DenseState(
@@ -177,9 +212,9 @@ def dp_from_dense(
     specs = state_specs(dense.params)
 
     def per_device(params, *moments):
-        flat_p, _ = ravel_pytree(params)
+        flat_p, _ = _flat_ravel(params)
         template = tx.init(_shard_of(flat_p, axis))
-        shards = [_shard_of(ravel_pytree(m)[0], axis) for m in moments]
+        shards = [_shard_of(_flat_ravel(m)[0], axis) for m in moments]
         return TrainState(
             step=jnp.asarray(dense.step, jnp.int32),
             params=params,
@@ -264,12 +299,28 @@ def dense_from_pp(
     data_axis: str = "data",
     pipe_axis: str = "pipe",
 ) -> DenseState:
-    """The pp tier's ``TrainState`` → :class:`DenseState`."""
+    """The pp tier's ``TrainState`` → :class:`DenseState`.
+
+    Supports the ``split_gpt2_params`` layout (schedules ``gpipe`` /
+    ``1f1b``). The interleaved layout (``schedule='interleaved'``, stages
+    carrying an extra ``[V]`` chunk dim) is rejected HERE, before the
+    expensive all-gather — convert those checkpoints by resuming on the
+    same interleaved geometry (round-3 advisor finding)."""
     from mpit_tpu.comm import collectives as C
     from mpit_tpu.parallel import (
         make_gpt2_pp_train_step,
         unsplit_gpt2_params,
     )
+
+    _require_single_controller("dense_from_pp")
+    probe = state.params["stages"]["ln1"]["scale"]  # split: [P, k, D]
+    if probe.ndim != 3:
+        raise ValueError(
+            "dense_from_pp supports the split layout only ([n_pipe, k, ...]"
+            f" stages); got rank-{probe.ndim} ln1/scale — an interleaved "
+            "(schedule='interleaved') checkpoint carries [n_pipe, V, k', ...]"
+            " and cannot convert; resume it on the same interleaved geometry"
+        )
 
     def per_device(state):
         local = _local_view_3d(state.params)
@@ -382,6 +433,7 @@ def dense_from_cptp(
     model_axis: str = "model",
 ) -> DenseState:
     """The dp×cp×tp tier's ``TrainState`` → :class:`DenseState`."""
+    _require_single_controller("dense_from_cptp")
     from mpit_tpu.comm import collectives as C
     from mpit_tpu.parallel import (
         make_gpt2_dp_cp_tp_train_step,
@@ -526,6 +578,7 @@ def dense_from_3d(
     shard_map gather per group (all-gather over data + the pipe/model
     coordinates come out in the split layout's own sharding).
     """
+    _require_single_controller("dense_from_3d")
     from mpit_tpu.parallel.threed import (
         _merge,
         _partition_block_tree,
@@ -586,4 +639,69 @@ def dense_from_3d(
         params=to_dense(state.params),
         moments=[to_dense(m) for m in moments_split],
         scalars=[np.asarray(s) for s in scalars],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Dense-state disk format (elastic rescale: the cross-GEOMETRY checkpoint)
+# ---------------------------------------------------------------------------
+#
+# Orbax checkpoints are pinned to the run geometry (train/checkpoint.py
+# ensure_meta); the dense .npz is the geometry-FREE artifact: save it from
+# any tier/mesh (`--save-dense`), restore it onto any other
+# (`--resume-dense`) — including a different data-axis size with ZeRO-1
+# shards re-cut (the preempt→rescale story, RECOVERY.md §4).
+
+
+def save_dense(path: str, dense: DenseState) -> str:
+    """Write a :class:`DenseState` as one ``.npz`` (portable numpy)."""
+    import os
+
+    arrays: dict[str, np.ndarray] = {"__step__": np.asarray(dense.step)}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(dense.params)[0]:
+        arrays["p/" + jax.tree_util.keystr(kp)] = np.asarray(leaf)
+    for m, tree in enumerate(dense.moments):
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            arrays[f"m{m}/" + jax.tree_util.keystr(kp)] = np.asarray(leaf)
+    for i, s in enumerate(dense.scalars):
+        arrays[f"s/{i}"] = np.asarray(s)
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)  # atomic: no torn file on preemption
+    return path
+
+
+def load_dense(path: str) -> DenseState:
+    """Read a :func:`save_dense` file back into a :class:`DenseState`."""
+
+    def nest(flat: dict) -> dict:
+        out: dict = {}
+        for key, leaf in flat.items():
+            parts = [p for p in key.split("/") if p]
+            node = out
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = leaf
+        return out
+
+    with np.load(path) as z:
+        step = int(z["__step__"])
+        params_flat, moments_flat, scalars = {}, {}, {}
+        for key in z.files:
+            if key == "__step__":
+                continue
+            head, _, rest = key.partition("/")
+            # keystr paths look like ['a']['b']; normalize to a/b.
+            clean = rest.replace("']['", "/").strip("[']")
+            if head == "p":
+                params_flat[clean] = z[key]
+            elif head == "s":
+                scalars[int(rest)] = z[key]
+            else:
+                moments_flat.setdefault(int(head[1:]), {})[clean] = z[key]
+    return DenseState(
+        step=step,
+        params=nest(params_flat),
+        moments=[nest(moments_flat[m]) for m in sorted(moments_flat)],
+        scalars=[scalars[i] for i in sorted(scalars)],
     )

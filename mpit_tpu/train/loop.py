@@ -87,6 +87,15 @@ def hardened_loop(
     Returns ``{"state", "losses", "restores", "preempted", "steps",
     "eval"}`` (``eval``: the last eval_hook result, or absent).
     """
+    if ckpt is not None and specs is None:
+        # Fail at configuration time, not deep in the divergence-restore
+        # path with an opaque `'NoneType' object is not callable` (round-3
+        # advisor finding): restore needs the state's PartitionSpecs.
+        raise ValueError(
+            "hardened_loop: `ckpt` given without `specs` — divergence "
+            "restore re-shards the checkpoint and needs a zero-arg "
+            "callable returning the state's PartitionSpecs"
+        )
     logger = logger or MetricLogger()
     meter = Throughput()
     start_step = int(state.step)
@@ -194,6 +203,14 @@ def hardened_loop(
                             raise
                         target = max(candidates)
                         restores += 1
+                        if tracing:
+                            # The step counter jumps backward across the
+                            # restore; a window left open would silently
+                            # span the rollback discontinuity (round-3
+                            # advisor finding). End the capture here.
+                            jax.profiler.stop_trace()
+                            tracing = False
+                            trace_done = True
                         state = ckpt.restore(state, specs(), step=target)
                         step = int(state.step)
                         restore_before = target

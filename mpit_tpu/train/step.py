@@ -213,16 +213,36 @@ def make_train_step(
             compiled[key] = f
         return f(state, batch)
 
+    # AOT seam: the raw jax.jit object, for `.lower()` against abstract
+    # args on a topology mesh (utils/aot.py compile_multichip).
+    step_fn.build = build_step
     return init_fn, step_fn, state_specs
 
 
 def make_eval_step(eval_fn: Callable, world, *, axis: str = "data"):
     """Build a jitted SPMD eval step: ``eval_fn(params, extra, batch) ->
-    metrics`` (pytree of scalars), pmean-reduced across replicas."""
+    metrics`` (pytree of scalars), pmean-reduced across replicas.
+
+    Exact-count contract: when ``eval_fn`` returns a ``"_weight"`` entry
+    (its local count of real — non-pad — rows, see the val sweep's
+    ``valid`` mask), every other metric is treated as a weighted mean and
+    combined as ``psum(m*w)/psum(w)``; the returned ``"_weight"`` is the
+    global real-row count so the host sweep can weight batches the same
+    way. Without ``"_weight"`` the old plain-pmean contract applies.
+    """
 
     def _per_device(params, extra, batch):
-        metrics = eval_fn(params, extra, batch)
-        return jax.tree.map(lambda m: lax.pmean(m, axis), metrics)
+        metrics = dict(eval_fn(params, extra, batch))
+        w = metrics.pop("_weight", None)
+        if w is None:
+            return jax.tree.map(lambda m: lax.pmean(m, axis), metrics)
+        wsum = lax.psum(w, axis)
+        out = {
+            k: lax.psum(m * w, axis) / jnp.maximum(wsum, 1.0)
+            for k, m in metrics.items()
+        }
+        out["_weight"] = wsum
+        return out
 
     compiled: dict = {}
 

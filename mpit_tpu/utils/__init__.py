@@ -6,6 +6,14 @@ TPU-native toolkit: profiler traces, blocking step timers, XLA cost
 analysis, roofline estimates, and collective-traffic models.
 """
 
+from mpit_tpu.utils.aot import (
+    abstract_state,
+    abstractify,
+    aot_compile,
+    memory_report,
+    topology_devices,
+    topology_world,
+)
 from mpit_tpu.utils.profiling import (
     ChipSpec,
     CommModel,
@@ -21,6 +29,12 @@ from mpit_tpu.utils.profiling import (
 )
 
 __all__ = [
+    "abstract_state",
+    "abstractify",
+    "aot_compile",
+    "memory_report",
+    "topology_devices",
+    "topology_world",
     "ChipSpec",
     "CommModel",
     "StepTimer",

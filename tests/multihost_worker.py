@@ -1,0 +1,108 @@
+"""Worker for the multi-host bootstrap e2e (tests/test_comm.py).
+
+Launched as 2+ separate OS processes by ``TestMultiHostBootstrap``, each
+with the env contract ``comm/mesh.py::_maybe_distributed_initialize``
+reads (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+``JAX_PROCESS_ID``) — the TPU-native analogue of ranks launched under
+``mpirun`` joining ``MPI_COMM_WORLD`` (SURVEY.md §4.1). Each process
+contributes its local CPU devices; ``mpit_tpu.init()`` must come up with
+the GLOBAL mesh, run a real cross-process ``psum``, and round-trip a
+sharded checkpoint through orbax's multi-process path.
+
+Prints one ``MULTIHOST_OK {...}`` JSON line on success; any assertion or
+hang (the launcher enforces a timeout) fails the test.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    ckpt_dir = sys.argv[1]
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    import mpit_tpu
+
+    # init() performs jax.distributed.initialize from the env contract.
+    world = mpit_tpu.init()
+
+    n_proc = int(os.environ["JAX_NUM_PROCESSES"])
+    pid = int(os.environ["JAX_PROCESS_ID"])
+    assert world.process_count == n_proc, (world.process_count, n_proc)
+    assert world.process_index == pid, (world.process_index, pid)
+    local = world.local_devices()
+    n_local = len(local)
+    assert n_local >= 1
+    assert world.num_devices == n_proc * n_local, (
+        world.num_devices, n_proc, n_local,
+    )
+    assert all(d.process_index == pid for d in local)
+
+    # One global collective across the process boundary: each device
+    # contributes its global mesh position; the psum must see ALL of them.
+    n = world.num_devices
+
+    def body(x):
+        return jax.lax.psum(x, "data")
+
+    f = jax.jit(world.shard_map(body, in_specs=P("data"), out_specs=P()))
+    from mpit_tpu.data import shard_batch
+
+    x = shard_batch(world, np.arange(n, dtype=np.float32).reshape(n, 1))
+    total = float(np.asarray(f(x)[0]).item())
+    assert total == n * (n - 1) / 2, total
+
+    # Checkpoint save/restore across processes (orbax multi-process path):
+    # a data-sharded array must restore bit-exactly on every process.
+    from mpit_tpu.train import CheckpointManager
+    from mpit_tpu.train.step import TrainState
+
+    # Every leaf must be a GLOBAL array for orbax's multi-process
+    # serialization (host-local scalars are rejected) — in real training
+    # the jitted init/step functions produce exactly that; here the state
+    # is hand-built, so place the scalar replicated explicitly.
+    from jax.sharding import NamedSharding
+
+    state = TrainState(
+        step=jax.device_put(
+            jnp.asarray(3, jnp.int32), NamedSharding(world.mesh, P())
+        ),
+        params={"w": x},
+        opt_state=(),
+        extra=(),
+    )
+    specs = TrainState(step=P(), params={"w": P("data")}, opt_state=(), extra=())
+    mgr = CheckpointManager(ckpt_dir, world, async_save=False)
+    mgr.save(3, state)
+    mgr.wait()
+    restored = mgr.restore(state, specs)
+    assert int(restored.step) == 3  # replicated: locally addressable
+    # The restored w spans both processes; each process verifies exactly
+    # its own addressable shards against the global ground truth.
+    want = np.arange(n, dtype=np.float32).reshape(n, 1)
+    shards = restored.params["w"].addressable_shards
+    assert len(shards) == n_local
+    for sh in shards:
+        np.testing.assert_array_equal(np.asarray(sh.data), want[sh.index])
+
+    print(
+        "MULTIHOST_OK "
+        + json.dumps(
+            {
+                "process": pid,
+                "n_processes": n_proc,
+                "local_devices": n_local,
+                "global_devices": world.num_devices,
+                "psum": total,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

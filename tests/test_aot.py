@@ -1,0 +1,118 @@
+"""AOT topology compilation (utils/aot.py; round-3 verdict item 2).
+
+These tests drive the REAL TPU compiler against a virtual ``v5e:2x4``
+topology — no hardware executes. They are the regression net for the
+class of bug only that compiler can see: Mosaic lowering rejections and
+layout-pass tile padding (the round-3 ZeRO-1 20.6 GB compile-OOM).
+
+Needs the TPU PJRT plugin importable from this host; skipped cleanly
+where it is not. The full-size (322M-param) variant of the memory
+regression runs in ``compile_multichip.py`` (driver-run); here a small
+model with the same *pathology class* (a narrow ``[*, 8]`` leaf among
+wide ones) keeps the signal at test-suite cost.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+
+def _topology_world_or_skip(axes):
+    from mpit_tpu.utils.aot import topology_world
+
+    try:
+        return topology_world(axes)
+    except Exception as e:  # plugin/topology unavailable on this host
+        pytest.skip(f"TPU topology AOT unavailable: {type(e).__name__}: {e}")
+
+
+class TestTopologyCompile:
+    def test_psum_compiles_for_v5e8(self):
+        from mpit_tpu.utils.aot import abstractify, aot_compile, memory_report
+
+        world = _topology_world_or_skip({"data": 8})
+        f = jax.jit(
+            world.shard_map(
+                lambda x: jax.lax.psum(x, "data"),
+                in_specs=P("data"),
+                out_specs=P(),
+            )
+        )
+        x = abstractify(
+            jax.ShapeDtypeStruct((8, 128), jnp.float32), world.mesh, P("data")
+        )
+        rep = memory_report(aot_compile(f, x))
+        assert rep["output_bytes"] > 0
+
+    def test_pallas_ring_allreduce_mosaic_compiles(self):
+        """The native-tier DMA kernel accepted by the real Mosaic
+        compiler — upgraded from 'interpret-only' (this is what caught
+        the kernel's in-body pvary, which Mosaic rejects)."""
+        from mpit_tpu.ops import ring_allreduce
+        from mpit_tpu.utils.aot import abstractify, aot_compile
+
+        world = _topology_world_or_skip({"data": 8})
+        f = jax.jit(
+            world.shard_map(
+                lambda v: ring_allreduce(v, "data", interpret=False),
+                in_specs=P("data"),
+                out_specs=P("data"),
+            )
+        )
+        x = abstractify(
+            jax.ShapeDtypeStruct((8, 4096), jnp.float32), world.mesh, P("data")
+        )
+        aot_compile(f, x)  # any Mosaic/layout rejection raises
+
+    def test_zero1_no_tile_pad_blowup(self):
+        """Round-3 top item's regression net: a param tree containing a
+        narrow [*, 8] leaf (the MoE-router shape class) must compile its
+        ZeRO-1 update without the [total/8, 8] tile-padded whole-vector
+        intermediate — temp memory stays under 4x the payload (the
+        pathology was 16x)."""
+        from mpit_tpu.opt import goo_adam
+        from mpit_tpu.opt.sharded import sharded, state_partition_specs
+        from mpit_tpu.utils.aot import abstractify, aot_compile, memory_report
+
+        world = _topology_world_or_skip({"data": 8})
+        mesh = world.mesh
+        # ~8.4M params; the [1024, 8] router-class leaf sits between wide
+        # leaves, exactly the extraction XLA rewrote pathologically.
+        params = {
+            "wide_a": jax.ShapeDtypeStruct((1024, 4096), jnp.float32),
+            "router": jax.ShapeDtypeStruct((1024, 8), jnp.float32),
+            "wide_b": jax.ShapeDtypeStruct((4096, 1024), jnp.float32),
+        }
+        payload = sum(
+            int(np.prod(l.shape)) * 4 for l in jax.tree.leaves(params)
+        )
+        tx = goo_adam(1e-3)
+        stx = sharded(tx, "data")
+        specs = state_partition_specs(tx, params, 8, "data")
+
+        def step(grads, state, p):
+            u, s = stx.update(grads, state, p)
+            return jax.tree.map(lambda a, b: a + b, p, u), s
+
+        state_shapes = jax.eval_shape(
+            lambda p: jax.shard_map(
+                stx.init, mesh=mesh, in_specs=P(), out_specs=specs
+            )(p),
+            params,
+        )
+        state = abstractify(state_shapes, mesh, specs)
+        rep_params = abstractify(params, mesh, P())
+        f = jax.jit(
+            world.shard_map(
+                step, in_specs=(P(), specs, P()), out_specs=(P(), specs)
+            )
+        )
+        rep = memory_report(aot_compile(f, rep_params, state, rep_params))
+        assert rep["temp_bytes"] < 4 * payload, (
+            f"ZeRO-1 temp {rep['temp_bytes']/2**20:.0f} MiB exceeds 4x the "
+            f"{payload/2**20:.0f} MiB payload — tile-pad pathology regressed"
+        )

@@ -379,3 +379,83 @@ class TestCrossTierRestore:
         # interleaved: V=2, P=1 (2 layers -> 2 chunks of 1)
         ilv = split_gpt2_params_interleaved(full, 2, 1, 2)
         assert jax.tree.leaves(ilv["stages"])[0].shape[:3] == (1, 2, 1)
+
+
+class TestElasticRescale:
+    """Round-3 verdict item 7: preempt on 8 devices, restore the dense
+    checkpoint onto a 4-device mesh (data axis halved, ZeRO-1 shards
+    re-cut), continue — the trajectory matches the 8-device continuation
+    per-leaf, because sync-DP is mesh-size invariant given the same
+    global batches."""
+
+    def test_dense_npz_roundtrip_exact(self, tmp_path):
+        from mpit_tpu.train import load_dense, save_dense
+        from mpit_tpu.train.step import make_train_step
+
+        world = mpit_tpu.init()
+        params = _init_params()
+        tx = goo(LR, MOM)
+        init_fn, step_fn, _ = make_train_step(_dp_loss_fn(), tx, world)
+        state = init_fn(params)
+        for toks in _batches(2):
+            state, _ = step_fn(state, shard_batch(world, {"tokens": toks}))
+        dense = dense_from_dp(state)
+        path = str(tmp_path / "state.npz")
+        save_dense(path, dense)
+        back = load_dense(path)
+        assert back.step == dense.step
+        jax.tree.map(
+            np.testing.assert_array_equal, back.params, dense.params
+        )
+        for a, b in zip(back.moments, dense.moments):
+            jax.tree.map(np.testing.assert_array_equal, a, b)
+        for a, b in zip(back.scalars, dense.scalars):
+            np.testing.assert_array_equal(a, b)
+
+    def test_8_to_4_device_trajectory_parity(self, tmp_path):
+        from mpit_tpu.train import load_dense, save_dense
+        from mpit_tpu.train.step import make_train_step
+
+        world8 = mpit_tpu.init()
+        n8 = world8.num_devices
+        if n8 < 8:
+            pytest.skip("needs the fake 8-device mesh")
+        params = _init_params()
+        tx = goo(LR, MOM)
+        loss_fn = _dp_loss_fn()
+
+        # phase 1: 3 steps on 8 devices
+        init8, step8, _ = make_train_step(loss_fn, tx, world8)
+        state8 = init8(params)
+        toks_all = _batches(7)
+        for toks in toks_all[:3]:
+            state8, _ = step8(state8, shard_batch(world8, {"tokens": toks}))
+
+        # "preempt": dense out through disk, restore onto 4 devices
+        path = str(tmp_path / "rescale.npz")
+        save_dense(path, dense_from_dp(state8))
+        world4 = mpit_tpu.init(
+            {"data": 4}, devices=jax.devices()[:4], set_default=False
+        )
+        state4 = dp_from_dense(load_dense(path), tx, world4)
+        assert int(state4.step) == 3
+        # ZeRO-1 shards re-cut: 4-way vectors, not 8-way
+        v4 = [l for l in jax.tree.leaves(state4.opt_state) if l.ndim >= 1]
+        assert all(
+            len(l.sharding.device_set) == 4 for l in v4
+        ), "moments not resharded onto the 4-device mesh"
+
+        # phase 2: continue BOTH sizes on the same global batches
+        init4, step4, _ = make_train_step(loss_fn, tx, world4)
+        del init4
+        for toks in toks_all[3:]:
+            state8, _ = step8(state8, shard_batch(world8, {"tokens": toks}))
+            state4, _ = step4(state4, shard_batch(world4, {"tokens": toks}))
+        assert int(state4.step) == int(state8.step) == 7
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+            ),
+            state4.params,
+            state8.params,
+        )

@@ -179,8 +179,11 @@ class TestSharded:
         state = gopt.sharded_init(world8, tx, params)
         n = world8.num_devices
         total = 5 * 3 + 3
-        padded = total + ((-total) % n)
-        # momentum buffer is one flat padded vector sharded over devices
+        from mpit_tpu.opt.sharded import padded_len
+
+        padded = padded_len(total, n)
+        # momentum buffer is one flat padded vector (lane-aligned pad
+        # multiple n*LANE — tile-friendly collectives) sharded over devices
         assert state.momentum.shape == (padded,)
         assert len(state.momentum.sharding.device_set) == n
 
