@@ -238,7 +238,6 @@ def run_spmd(
     init_fn, step_fn, state_specs = make_train_step(
         loss_fn, tx, world, axis=axis, zero1=cfg.zero1, stateful=stateful
     )
-    state = init_fn(params, extra)
 
     if (cfg.resume_dense or cfg.save_dense) and (not cfg.zero1 or stateful):
         # Fail before any training happens: the dense format carries the
@@ -248,21 +247,35 @@ def run_spmd(
             "run with --zero1 true and a stateless model (BatchNorm "
             "models use same-geometry --ckpt-dir resume)"
         )
+    ckpt = None
+    if cfg.ckpt_dir:
+        ckpt = CheckpointManager(cfg.ckpt_dir, world)
+        ckpt.ensure_meta(run_meta(cfg))
+    if cfg.resume_dense and ckpt is not None and ckpt.latest_step() is not None:
+        # Two competing restore sources is always a configuration mistake:
+        # silently preferring either one trains the wrong trajectory
+        # (round-4 review finding). The dense file bootstraps a NEW
+        # geometry; once its run writes checkpoints, plain --ckpt-dir
+        # resume takes over and --resume-dense must be dropped.
+        raise SystemExit(
+            f"--resume-dense given but --ckpt-dir {cfg.ckpt_dir} already "
+            "holds a checkpoint; drop --resume-dense to resume in place, "
+            "or point --ckpt-dir at a fresh directory for the rescaled run"
+        )
     if cfg.resume_dense:
         # Elastic rescale (RECOVERY.md §4): restore the geometry-free
         # dense .npz onto THIS mesh — any data-axis size; ZeRO-1 shards
         # are re-cut by dp_from_dense. Sync-DP trajectories are mesh-size
         # invariant given the same global batches, so the continuation
-        # matches an uninterrupted run at the new size.
+        # matches an uninterrupted run at the new size. (Replaces init_fn
+        # entirely — initializing a full sharded state only to discard it
+        # would transiently double optimizer memory.)
         from mpit_tpu.train import dp_from_dense, load_dense
 
         state = dp_from_dense(load_dense(cfg.resume_dense), tx, world)
-
-    ckpt = None
-    if cfg.ckpt_dir:
-        ckpt = CheckpointManager(cfg.ckpt_dir, world)
-        ckpt.ensure_meta(run_meta(cfg))
-        if ckpt.latest_step() is not None:
+    else:
+        state = init_fn(params, extra)
+        if ckpt is not None and ckpt.latest_step() is not None:
             state = ckpt.restore(state, state_specs(params, extra))
 
     logger = MetricLogger()

@@ -97,6 +97,13 @@ class FileClassification:
     rrc_ratio: tuple = (3 / 4, 4 / 3)
 
     def __post_init__(self):
+        if self.augment_mode not in ("shift", "rrc"):
+            # A typo here would otherwise silently train with the wrong
+            # augmentation (round-4 review finding).
+            raise ValueError(
+                f"augment_mode must be 'shift' or 'rrc', got "
+                f"{self.augment_mode!r}"
+            )
         with open(os.path.join(self.data_dir, _META)) as f:
             self.meta = json.load(f)
         if self.meta.get("kind") != "classification":

@@ -117,6 +117,22 @@ def import_image_directory(
     class_names = _class_dirs(train_root)
     if not class_names:
         raise ValueError(f"{train_root}: no class subdirectories")
+
+    if has_splits and os.path.isdir(val_root):
+        # Validate the val tree BEFORE the (potentially long) train
+        # decode, so a missing class directory fails fast and clearly.
+        missing = [
+            c
+            for c in class_names
+            if not os.path.isdir(os.path.join(val_root, c))
+        ]
+        if missing:
+            raise ValueError(
+                f"{val_root}: missing class directories {missing} (every "
+                "train/ class needs a val/ counterpart; use val_fraction "
+                "for an automatic split instead)"
+            )
+
     images, labels = _decode_split(train_root, class_names, size)
 
     if has_splits and os.path.isdir(val_root):

@@ -120,55 +120,69 @@ def _mask(s, qoff, koff, qi, bq, ki, bk):
 
 def _fwd_kernel(
     qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-    *, block_k, causal, scale,
+    *, block_k, causal, scale, num_heads, head_dim,
 ):
-    bq, d = q_ref.shape[1], q_ref.shape[2]
+    """All-heads forward: operands arrive head-PACKED ``[1, rows, H·D]``
+    (the model's native sequence-major layout viewed flat over heads —
+    round-4 change, see the plumbing comment below). The head loop is
+    python-unrolled; every per-head matmul is a static lane-slice of the
+    packed VMEM tile."""
+    bq = q_ref.shape[1]
     t = k_ref.shape[1]
+    h_n, d = num_heads, head_dim
     qi = pl.program_id(1)
     qoff, koff = qoff_ref[0], koff_ref[0]
-    # Matmul operands stay in the INPUT dtype (bf16 on the training path)
-    # with f32 accumulation — an f32xf32 MXU matmul runs at a fraction of
-    # the bf16 rate, and the old cast-everything-to-f32 kernels were
-    # compute-bound on exactly that (round-3 finding: ~2.8 ms/layer vs a
-    # ~0.7 ms bf16 bound at B32/T512). Softmax statistics stay f32; the
-    # scale folds into the f32 scores, not the bf16 operand.
-    q = q_ref[0]  # [bq, d], input dtype
-
-    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
 
     n_k = _causal_bounds(qoff, koff, qi, bq, block_k, t, causal=causal)
+    lse_cols = []
+    for h in range(h_n):
+        # Matmul operands stay in the INPUT dtype (bf16 on the training
+        # path) with f32 accumulation — an f32xf32 MXU matmul runs at a
+        # fraction of the bf16 rate (round-3 finding). Softmax statistics
+        # stay f32; the scale folds into the f32 scores.
+        q = q_ref[0, :, h * d : (h + 1) * d]  # [bq, d], input dtype
 
-    def body(ki, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [bq, bk] f32
-        if causal:
-            s = _mask(s, qoff, koff, qi, bq, ki, block_k)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=1)
-        acc_new = alpha[:, None] * acc + jax.lax.dot_general(
-            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((bq,), jnp.float32)
+        acc0 = jnp.zeros((bq, d), jnp.float32)
+
+        def body(ki, carry):
+            m, l, acc = carry
+            rows = pl.ds(ki * block_k, block_k)
+            k_blk = k_ref[0, rows, h * d : (h + 1) * d]
+            v_blk = v_ref[0, rows, h * d : (h + 1) * d]
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [bq, bk] f32
+            if causal:
+                s = _mask(s, qoff, koff, qi, bq, ki, block_k)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + jnp.sum(p, axis=1)
+            acc_new = alpha[:, None] * acc + jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+
+        m, l, acc = lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+        # Fully-masked rows (empty k-range under offsets): o = 0,
+        # lse = -BIG — the exact neutral element of the lse-merge.
+        empty = m <= _NEG_INF / 2
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o = jnp.where(empty[:, None], 0.0, acc / l_safe[:, None])
+        o_ref[0, :, h * d : (h + 1) * d] = o.astype(o_ref.dtype)
+        lse_cols.append(jnp.where(empty, _NEG_INF, m + jnp.log(l_safe)))
+
+    # lse lanes: one column per head, zero-padded to the 128-lane tile.
+    lse_mat = jnp.stack(lse_cols, axis=1)  # [bq, H] f32
+    if h_n < _LANES:
+        lse_mat = jnp.concatenate(
+            [lse_mat, jnp.zeros((bq, _LANES - h_n), jnp.float32)], axis=1
         )
-        return m_new, l_new, acc_new
-
-    m, l, acc = lax.fori_loop(0, n_k, body, (m0, l0, acc0))
-    # Fully-masked rows (empty k-range under offsets): o = 0, lse = -BIG —
-    # the exact neutral element of the lse-merge.
-    empty = m <= _NEG_INF / 2
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o = jnp.where(empty[:, None], 0.0, acc / l_safe[:, None])
-    o_ref[0] = o.astype(o_ref.dtype)
-    lse = jnp.where(empty, _NEG_INF, m + jnp.log(l_safe))
-    lse_ref[0] = lax.broadcast_in_dim(lse, (lse_ref.shape[1], _LANES), (0,))
+    lse_ref[0] = lse_mat
 
 
 def _p_from_lse(s, lse):
@@ -180,54 +194,56 @@ def _p_from_lse(s, lse):
 
 def _bwd_dq_kernel(
     qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, block_k, causal, scale,
+    *, block_k, causal, scale, num_heads, head_dim,
 ):
-    bq, d = q_ref.shape[1], q_ref.shape[2]
+    bq = q_ref.shape[1]
     t = k_ref.shape[1]
+    h_n, d = num_heads, head_dim
     qi = pl.program_id(1)
     qoff, koff = qoff_ref[0], koff_ref[0]
-    q = q_ref[0]  # input dtype; scale folds into the f32 scores
-    do = do_ref[0]
-    lse = lse_ref[0, :, 0]
-    delta = delta_ref[0, :, 0]
 
     n_k = _causal_bounds(qoff, koff, qi, bq, block_k, t, causal=causal)
+    for h in range(h_n):
+        q = q_ref[0, :, h * d : (h + 1) * d]  # input dtype
+        do = do_ref[0, :, h * d : (h + 1) * d]
+        lse = lse_ref[0, :, h]
+        delta = delta_ref[0, :, h]
 
-    def body(ki, dq):
-        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if causal:
-            s = _mask(s, qoff, koff, qi, bq, ki, block_k)
-        p = _p_from_lse(s, lse)  # [bq, bk] f32
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta[:, None])  # [bq, bk] f32
-        return dq + jax.lax.dot_general(
-            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        def body(ki, dq):
+            rows = pl.ds(ki * block_k, block_k)
+            k_blk = k_ref[0, rows, h * d : (h + 1) * d]
+            v_blk = v_ref[0, rows, h * d : (h + 1) * d]
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if causal:
+                s = _mask(s, qoff, koff, qi, bq, ki, block_k)
+            p = _p_from_lse(s, lse)  # [bq, bk] f32
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta[:, None])  # [bq, bk] f32
+            return dq + jax.lax.dot_general(
+                ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
 
-    dq = lax.fori_loop(0, n_k, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+        dq = lax.fori_loop(0, n_k, body, jnp.zeros((bq, d), jnp.float32))
+        dq_ref[0, :, h * d : (h + 1) * d] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
     qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref,
-    *, block_q, causal, scale,
+    *, block_q, causal, scale, num_heads, head_dim,
 ):
-    bk, d = k_ref.shape[1], k_ref.shape[2]
+    bk = k_ref.shape[1]
     t = q_ref.shape[1]
+    h_n, d = num_heads, head_dim
     ki = pl.program_id(1)
     qoff, koff = qoff_ref[0], koff_ref[0]
-    k_blk = k_ref[0]  # input dtype (bf16 matmul operands, f32 accumulate)
-    v_blk = v_ref[0]
 
     n_q = t // block_q
     if causal:
@@ -236,57 +252,73 @@ def _bwd_dkv_kernel(
     else:
         q_start = 0
 
-    def body(qi, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qi * block_q, block_q), :]
-        do = do_ref[0, pl.ds(qi * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q), 0]
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [bq, bk]
-        if causal:
-            s = _mask(s, qoff, koff, qi, block_q, ki, bk)
-        p = _p_from_lse(s, lse)
-        p_lo = p.astype(do.dtype)
-        dv_new = dv + jax.lax.dot_general(
-            p_lo, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bk, d]
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta[:, None])
-        dk_new = dk + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bk, d]
-        return dk_new, dv_new
+    for h in range(h_n):
+        k_blk = k_ref[0, :, h * d : (h + 1) * d]  # input dtype
+        v_blk = v_ref[0, :, h * d : (h + 1) * d]
 
-    z = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = lax.fori_loop(q_start, n_q, body, (z, z))
-    # dL/dk = scale · dsᵀ·q_raw — q is UNscaled here (the scale folds
-    # into the f32 scores), so apply the factor explicitly.
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        def body(qi, carry):
+            dk, dv = carry
+            rows = pl.ds(qi * block_q, block_q)
+            q = q_ref[0, rows, h * d : (h + 1) * d]
+            do = do_ref[0, rows, h * d : (h + 1) * d]
+            lse = lse_ref[0, rows, h]
+            delta = delta_ref[0, rows, h]
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [bq, bk]
+            if causal:
+                s = _mask(s, qoff, koff, qi, block_q, ki, bk)
+            p = _p_from_lse(s, lse)
+            p_lo = p.astype(do.dtype)
+            dv_new = dv + jax.lax.dot_general(
+                p_lo, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bk, d]
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta[:, None])
+            dk_new = dk + jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bk, d]
+            return dk_new, dv_new
+
+        z = jnp.zeros((bk, d), jnp.float32)
+        dk, dv = lax.fori_loop(q_start, n_q, body, (z, z))
+        # dL/dk = scale · dsᵀ·q_raw — q is UNscaled here (the scale folds
+        # into the f32 scores), so apply the factor explicitly.
+        dk_ref[0, :, h * d : (h + 1) * d] = (dk * scale).astype(dk_ref.dtype)
+        dv_ref[0, :, h * d : (h + 1) * d] = dv.astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
-# pallas_call plumbing over [BH, T, D].
+# pallas_call plumbing over head-PACKED [B, T, H·D] views.
+#
+# Round-4 redesign: the kernels used to run on [B·H, T, D] views, forcing
+# a physical (0,2,1,3) transpose of every q/k/v/o/do around every call —
+# measured 21 ms/step of pure layout copies on the B=48/T=512 GPT-2 step
+# (trace, BENCHMARKS.md). The packed form is a FREE reshape of the
+# model's native [B, T, H, D]: blocks keep a legal (rows, H·D) trailing
+# geometry, the grid drops to (B, row_tiles) (all heads per program,
+# python-unrolled in the kernels), and lse/delta store one head per lane
+# of the 128-lane minor dim ([B, T, 128], heads 0..H-1) — so nothing in
+# the whole path materializes a transpose except the tiny [B, T, H]
+# delta/lse relayouts at the custom-vjp boundary.
 # ---------------------------------------------------------------------------
 
 
-def _specs(block_rows: int, d: int):
+def _specs(block_rows: int, hd: int):
     return pl.BlockSpec(
-        (1, block_rows, d), lambda bh, i: (bh, i, 0), memory_space=pltpu.VMEM
+        (1, block_rows, hd), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
     )
 
 
 def _row_spec(block_rows: int):
     return pl.BlockSpec(
-        (1, block_rows, _LANES), lambda bh, i: (bh, i, 0), memory_space=pltpu.VMEM
+        (1, block_rows, _LANES), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
     )
 
 
@@ -304,82 +336,98 @@ def _off(x):
     return jnp.asarray(x, jnp.int32).reshape((1,))
 
 
-def _fwd_3d(q, k, v, qoff, koff, *, causal, block_q, block_k, interpret):
-    bh, t, d = q.shape
+def _fwd_packed(q, k, v, qoff, koff, *, h, d, causal, block_q, block_k, interpret):
+    """q/k/v ``[B, T, H·D]`` → (o ``[B, T, H·D]``, lse ``[B, T, LANES]``)."""
+    b, t, hd = q.shape
     scale = 1.0 / (d ** 0.5)
-    grid = (bh, t // block_q)
+    grid = (b, t // block_q)
     kern = functools.partial(
-        _fwd_kernel, block_k=block_k, causal=causal, scale=scale
+        _fwd_kernel, block_k=block_k, causal=causal, scale=scale,
+        num_heads=h, head_dim=d,
     )
-    full = pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0), memory_space=pltpu.VMEM)
+    full = pl.BlockSpec(
+        (1, t, hd), lambda b_, i: (b_, 0, 0), memory_space=pltpu.VMEM
+    )
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
-        in_specs=[_smem_scalar(), _smem_scalar(), _specs(block_q, d), full, full],
-        out_specs=[_specs(block_q, d), _row_spec(block_q)],
+        in_specs=[_smem_scalar(), _smem_scalar(), _specs(block_q, hd), full, full],
+        out_specs=[_specs(block_q, hd), _row_spec(block_q)],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=_vma(q)),
-            jax.ShapeDtypeStruct((bh, t, _LANES), jnp.float32, vma=_vma(q)),
+            jax.ShapeDtypeStruct((b, t, hd), q.dtype, vma=_vma(q)),
+            jax.ShapeDtypeStruct((b, t, _LANES), jnp.float32, vma=_vma(q)),
         ],
         interpret=bool(interpret),
     )(qoff, koff, q, k, v)
     return o, lse
 
 
-def _bwd_3d(q, k, v, o, lse, do, g_lse, qoff, koff, *, causal, block_q, block_k, interpret):
-    bh, t, d = q.shape
+def _bwd_packed(q, k, v, o, lse, do, g_lse, qoff, koff, *, h, d, causal, block_q, block_k, interpret):
+    """Packed backward. ``lse`` arrives ``[B, T, LANES]`` (head-lanes);
+    ``g_lse`` (if any) ``[B, H, T]``."""
+    b, t, hd = q.shape
     scale = 1.0 / (d ** 0.5)
     # Flash-2 delta, with the lse cotangent folded in: ∂lse/∂S = P, so a
     # direct lse cotangent g adds g·P to dS — i.e. delta → delta − g.
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # Per-head delta straight from the packed layout: [B, T, H] — no
+    # transpose (sum over each head's lane group).
+    delta = jnp.sum(
+        (do.astype(jnp.float32) * o.astype(jnp.float32)).reshape(b, t, h, d),
+        axis=-1,
+    )
     if g_lse is not None:
-        delta = delta - g_lse
-    delta = jnp.broadcast_to(delta[..., None], (bh, t, _LANES))
+        delta = delta - g_lse.transpose(0, 2, 1)  # [B, H, T] -> [B, T, H]
+    if h < _LANES:
+        delta = jnp.concatenate(
+            [delta, jnp.zeros((b, t, _LANES - h), jnp.float32)], axis=-1
+        )
 
     full = lambda: pl.BlockSpec(
-        (1, t, d), lambda bh, i: (bh, 0, 0), memory_space=pltpu.VMEM
+        (1, t, hd), lambda b_, i: (b_, 0, 0), memory_space=pltpu.VMEM
     )
     full_row = lambda: pl.BlockSpec(
-        (1, t, _LANES), lambda bh, i: (bh, 0, 0), memory_space=pltpu.VMEM
+        (1, t, _LANES), lambda b_, i: (b_, 0, 0), memory_space=pltpu.VMEM
     )
 
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale
+            _bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale,
+            num_heads=h, head_dim=d,
         ),
-        grid=(bh, t // block_q),
+        grid=(b, t // block_q),
         in_specs=[
             _smem_scalar(), _smem_scalar(),
-            _specs(block_q, d),  # q tile
+            _specs(block_q, hd),  # q tile
             full(),  # k
             full(),  # v
-            _specs(block_q, d),  # do tile
-            _row_spec(block_q),  # lse tile
-            _row_spec(block_q),  # delta tile
+            _specs(block_q, hd),  # do tile
+            _row_spec(block_q),  # lse tile (head lanes)
+            _row_spec(block_q),  # delta tile (head lanes)
         ],
-        out_specs=_specs(block_q, d),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=_vma(q)),
+        out_specs=_specs(block_q, hd),
+        out_shape=jax.ShapeDtypeStruct((b, t, hd), q.dtype, vma=_vma(q)),
         interpret=bool(interpret),
     )(qoff, koff, q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale
+            _bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale,
+            num_heads=h, head_dim=d,
         ),
-        grid=(bh, t // block_k),
+        grid=(b, t // block_k),
         in_specs=[
             _smem_scalar(), _smem_scalar(),
             full(),  # q
-            _specs(block_k, d),  # k tile
-            _specs(block_k, d),  # v tile
+            _specs(block_k, hd),  # k tile
+            _specs(block_k, hd),  # v tile
             full(),  # do
             full_row(),  # lse
             full_row(),  # delta
         ],
-        out_specs=[_specs(block_k, d), _specs(block_k, d)],
+        out_specs=[_specs(block_k, hd), _specs(block_k, hd)],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), k.dtype, vma=_vma(q)),
-            jax.ShapeDtypeStruct((bh, t, d), v.dtype, vma=_vma(q)),
+            jax.ShapeDtypeStruct((b, t, hd), k.dtype, vma=_vma(q)),
+            jax.ShapeDtypeStruct((b, t, hd), v.dtype, vma=_vma(q)),
         ],
         interpret=bool(interpret),
     )(qoff, koff, q, k, v, do, lse, delta)
@@ -391,14 +439,37 @@ def _bwd_3d(q, k, v, o, lse, do, g_lse, qoff, koff, *, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-def _to3d(x):
+def _pack(x):
     b, t, h, d = x.shape
-    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    return x.reshape(b, t, h * d)  # free: contiguous view
 
 
-def _from3d(x, b, h):
-    bh, t, d = x.shape
-    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+# v5e VMEM is ~16 MiB/core; leave headroom for scratch/accumulators.
+_VMEM_BUDGET = 13 * 2**20
+
+
+def _check_vmem(t, h, d, block_q, block_k, itemsize):
+    """The head-packed layout keeps ALL-heads operands resident, so the
+    dkv kernel's worst case is q+do full ([T, H·D]) + k/v/dk/dv tiles +
+    f32 lse/delta rows ([T, 128] each). That is H× more resident than the
+    old per-(b,h) layout — a deliberate trade (it removed 21 ms/step of
+    layout transposes) that caps single-call T. Ring attention shards T,
+    so long context belongs on the CP tier, not one giant kernel call."""
+    hd = h * d
+    resident = (
+        2 * t * hd * itemsize  # q + do, full
+        + 4 * block_k * hd * itemsize  # k, v, dk, dv tiles
+        + 2 * t * _LANES * 4  # lse + delta, full rows f32
+    )
+    if resident > _VMEM_BUDGET:
+        raise ValueError(
+            f"flash kernel: T={t} x {h} heads x D={d} needs ~"
+            f"{resident / 2**20:.1f} MiB resident VMEM (> "
+            f"{_VMEM_BUDGET / 2**20:.0f} MiB budget) in the head-packed "
+            "layout. Shard the sequence (context-parallel ring attention, "
+            "parallel/ring_attention.py) or use attention='xla' for this "
+            "shape."
+        )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
@@ -411,30 +482,42 @@ def _flash(q, k, v, qoff, koff, causal, block_q, block_k, interpret):
 
 def _flash_fwd(q, k, v, qoff, koff, causal, block_q, block_k, interpret):
     b, t, h, d = q.shape
-    o3, lse3 = _fwd_3d(
-        _to3d(q), _to3d(k), _to3d(v), qoff, koff,
-        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+    if h > _LANES:
+        raise ValueError(f"flash kernel supports up to {_LANES} heads, got {h}")
+    if not interpret:
+        _check_vmem(t, h, d, block_q, block_k, q.dtype.itemsize)
+    op, lsep = _fwd_packed(
+        _pack(q), _pack(k), _pack(v), qoff, koff,
+        h=h, d=d, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
     )
-    out = _from3d(o3, b, h)
-    lse = lse3[:, :, 0].reshape(b, h, t)
-    return (out, lse), (q, k, v, out, lse3, qoff, koff)
+    out = op.reshape(b, t, h, d)
+    # [B, T, LANES] head-lane store -> public [B, H, T] (tiny f32 relayout)
+    lse = lsep[:, :, :h].transpose(0, 2, 1)
+    return (out, lse), (q, k, v, out, lsep, qoff, koff)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse3, qoff, koff = res
+    q, k, v, out, lsep, qoff, koff = res
     g_o, g_lse = g
     b, t, h, d = q.shape
     # Note: without symbolic_zeros on the custom_vjp, a discarded lse
     # output still arrives as a dense zeros cotangent — the fold below then
-    # costs one elementwise subtract on [BH, T], negligible vs attention.
-    g_lse3 = g_lse.reshape(b * h, t)
-    dq3, dk3, dv3 = _bwd_3d(
-        _to3d(q), _to3d(k), _to3d(v), _to3d(out), lse3, _to3d(g_o), g_lse3,
+    # costs one elementwise subtract on [B, T, H], negligible vs attention.
+    dqp, dkp, dvp = _bwd_packed(
+        _pack(q), _pack(k), _pack(v), _pack(out), lsep, _pack(g_o), g_lse,
         qoff, koff,
-        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+        h=h, d=d, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
     )
     f0 = np.zeros((1,), jax.dtypes.float0)  # int offsets: no cotangent
-    return _from3d(dq3, b, h), _from3d(dk3, b, h), _from3d(dv3, b, h), f0, f0
+    return (
+        dqp.reshape(b, t, h, d),
+        dkp.reshape(b, t, h, d),
+        dvp.reshape(b, t, h, d),
+        f0,
+        f0,
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

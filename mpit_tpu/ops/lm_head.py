@@ -112,7 +112,15 @@ def _xent2d_fwd(h, head, targets, vocab, block, compute_dtype):
         head,
         targets,
     )
-    (m, s, tl), _ = lax.scan(tick, init, (head_blocks, offsets))
+    # Unrolled vocab loop (round-4 chip measurement, B=48/T=512 GPT-2
+    # step): a rolled scan serializes the per-block matmuls behind loop
+    # plumbing and carries, costing ~15 ms/step; unrolling lets XLA
+    # software-pipeline blocks (120.8k -> 130.3k tok/s end to end).
+    # 7 blocks at vocab 50257 / block 8192 — full unroll; capped for
+    # degenerate tiny-block configs.
+    (m, s, tl), _ = lax.scan(
+        tick, init, (head_blocks, offsets), unroll=min(n_blocks, 16)
+    )
     lse = m + jnp.log(s)
     return lse - tl, (h, head, targets, lse)
 
@@ -141,7 +149,12 @@ def _xent2d_bwd(vocab, block, compute_dtype, res, ct):
         return dh, dhead_b
 
     dh0 = _match_vma(jnp.zeros(h.shape, jnp.float32), h, head, targets, ct)
-    dh, dhead_blocks = lax.scan(tick, dh0, (head_blocks, offsets))
+    # Unrolled like the forward (see _xent2d_fwd): also lets the stacked
+    # dhead blocks write straight to their output slices instead of
+    # dynamic-update-slicing through the scan carry machinery.
+    dh, dhead_blocks = lax.scan(
+        tick, dh0, (head_blocks, offsets), unroll=min(n_blocks, 16)
+    )
     dhead = dhead_blocks.reshape(head.shape)
     # Custom-VJP contract: each cotangent must carry exactly its primal's
     # varying type. When the cotangent picked up axes the primal doesn't
