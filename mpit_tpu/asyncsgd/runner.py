@@ -251,28 +251,37 @@ def run_spmd(
     if cfg.ckpt_dir:
         ckpt = CheckpointManager(cfg.ckpt_dir, world)
         ckpt.ensure_meta(run_meta(cfg))
-    if cfg.resume_dense and ckpt is not None and ckpt.latest_step() is not None:
-        # Two competing restore sources is always a configuration mistake:
-        # silently preferring either one trains the wrong trajectory
-        # (round-4 review finding). The dense file bootstraps a NEW
-        # geometry; once its run writes checkpoints, plain --ckpt-dir
-        # resume takes over and --resume-dense must be dropped.
-        raise SystemExit(
-            f"--resume-dense given but --ckpt-dir {cfg.ckpt_dir} already "
-            "holds a checkpoint; drop --resume-dense to resume in place, "
-            "or point --ckpt-dir at a fresh directory for the rescaled run"
-        )
+
+    # Restore-source resolution (restart-idempotent: a preemption
+    # supervisor may re-run the SAME rescale command line — see RECOVERY
+    # §4). The dense .npz bootstraps a new geometry; once the rescaled
+    # run has checkpointed PAST the dense step, the checkpoint is the
+    # newer truth and wins. A checkpoint at/behind the dense step loses
+    # to the dense file (fresh rescale over a stale/pre-rescale dir).
+    # Either way the choice is logged, never silent.
+    use_dense = False
     if cfg.resume_dense:
-        # Elastic rescale (RECOVERY.md §4): restore the geometry-free
-        # dense .npz onto THIS mesh — any data-axis size; ZeRO-1 shards
-        # are re-cut by dp_from_dense. Sync-DP trajectories are mesh-size
-        # invariant given the same global batches, so the continuation
-        # matches an uninterrupted run at the new size. (Replaces init_fn
-        # entirely — initializing a full sharded state only to discard it
-        # would transiently double optimizer memory.)
         from mpit_tpu.train import dp_from_dense, load_dense
 
-        state = dp_from_dense(load_dense(cfg.resume_dense), tx, world)
+        dense = load_dense(cfg.resume_dense)
+        latest = ckpt.latest_step() if ckpt is not None else None
+        use_dense = latest is None or latest <= dense.step
+        print(
+            f"[asyncsgd] restore source: "
+            + (
+                f"dense {cfg.resume_dense} (step {dense.step})"
+                if use_dense
+                else f"checkpoint {cfg.ckpt_dir} (step {latest} > dense "
+                f"step {dense.step})"
+            )
+        )
+    if use_dense:
+        # Elastic rescale (RECOVERY.md §4): ZeRO-1 shards re-cut for THIS
+        # mesh; sync-DP trajectories are mesh-size invariant given the
+        # same global batches. Replaces init_fn entirely — initializing a
+        # full sharded state only to discard it would transiently double
+        # optimizer memory.
+        state = dp_from_dense(dense, tx, world)
     else:
         state = init_fn(params, extra)
         if ckpt is not None and ckpt.latest_step() is not None:

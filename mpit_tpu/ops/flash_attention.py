@@ -449,18 +449,26 @@ _VMEM_BUDGET = 13 * 2**20
 
 
 def _check_vmem(t, h, d, block_q, block_k, itemsize):
-    """The head-packed layout keeps ALL-heads operands resident, so the
-    dkv kernel's worst case is q+do full ([T, H·D]) + k/v/dk/dv tiles +
-    f32 lse/delta rows ([T, 128] each). That is H× more resident than the
-    old per-(b,h) layout — a deliberate trade (it removed 21 ms/step of
-    layout transposes) that caps single-call T. Ring attention shards T,
-    so long context belongs on the CP tier, not one giant kernel call."""
+    """The head-packed layout keeps ALL-heads operands resident — H× more
+    than the old per-(b,h) layout, a deliberate trade (it removed
+    21 ms/step of layout transposes) that caps single-call T. Ring
+    attention shards T, so long context belongs on the CP tier, not one
+    giant kernel call. Estimate = max over the three kernels' resident
+    sets (dq holds k+v full plus block_q-sized q/do/dq tiles; dkv holds
+    q+do full plus block_k-sized k/v/dk/dv tiles + f32 lse/delta rows)."""
     hd = h * d
-    resident = (
+    rows = 2 * t * _LANES * 4  # lse + delta, full f32 rows
+    resident_dq = (
+        2 * t * hd * itemsize  # k + v, full
+        + 3 * block_q * hd * itemsize  # q, do, dq tiles
+        + 2 * block_q * _LANES * 4  # lse + delta tiles
+    )
+    resident_dkv = (
         2 * t * hd * itemsize  # q + do, full
         + 4 * block_k * hd * itemsize  # k, v, dk, dv tiles
-        + 2 * t * _LANES * 4  # lse + delta, full rows f32
+        + rows
     )
+    resident = max(resident_dq, resident_dkv)
     if resident > _VMEM_BUDGET:
         raise ValueError(
             f"flash kernel: T={t} x {h} heads x D={d} needs ~"
