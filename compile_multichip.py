@@ -22,8 +22,11 @@ Phases (mirroring the dryrun, plus the memory-regression shape):
 5.  ``3d-dp-tp-pp``     — Megatron blocks as pipeline stages.
 6.  ``3d-dp-cp-tp``     — ring attention inside the TP block (Pallas
     ring-flash kernel compiled by Mosaic for the topology).
-7.  ``ep-moe``          — expert-parallel MoE, per-group ZeRO-1.
-8.  ``pallas-ring-allreduce`` — the native-tier DMA kernel.
+7.  ``cp-long-context-16k`` — the CP training step at 16,384 global
+    tokens over 8 ring shards (per-shard T=2048 under the flash
+    kernel's auto head-grouping).
+8.  ``ep-moe``          — expert-parallel MoE, per-group ZeRO-1.
+9.  ``pallas-ring-allreduce`` — the native-tier DMA kernel.
 """
 
 from __future__ import annotations
@@ -255,6 +258,40 @@ def phase_3d_dp_cp_tp(topology):
     return {"params_mb": round(_params_mb(full), 1), **memory_report(compiled)}
 
 
+def phase_cp_long_context(topology):
+    """Long context for real: 16k global tokens ring-sharded 8 ways
+    (per-shard T=2048 — inside the flash kernel's VMEM envelope), the
+    Pallas ring-flash + streaming-head CP training step compiled by the
+    real TPU compiler. The capability SURVEY §6 long-context row
+    promises, proven at a scale one chip could never run."""
+    from mpit_tpu.models import GPT2, GPT2Config
+    from mpit_tpu.opt import goo_adam
+    from mpit_tpu.parallel.cp import make_gpt2_cp_train_step
+
+    world = topology_world({"data": 1, "seq": 8}, topology)
+    t_global = 16384
+    cfg = GPT2Config.small(max_seq_len=t_global, head_dtype=jnp.bfloat16)
+    model = GPT2(cfg)
+    params = _abstract_params(model, jnp.zeros((1, 32), jnp.int32))
+    init_fn, step_fn, state_specs = make_gpt2_cp_train_step(
+        cfg, goo_adam(3e-4), world, zero1=True, flash=True, interpret=False
+    )
+    specs = state_specs(params)
+    state = abstractify(jax.eval_shape(init_fn, params), world.mesh, specs)
+    batch_abs = abstractify(
+        {"tokens": jax.ShapeDtypeStruct((2, t_global), jnp.int32)},
+        world.mesh,
+        P("data", "seq"),
+    )
+    compiled = aot_compile(step_fn.build(params), state, batch_abs)
+    return {
+        "global_tokens": t_global,
+        "seq_shards": 8,
+        "params_mb": round(_params_mb(params), 1),
+        **memory_report(compiled),
+    }
+
+
 def phase_ep_moe(topology):
     from mpit_tpu.models import GPT2Config
     from mpit_tpu.models.gpt2_moe import GPT2MoE, MoESettings
@@ -308,6 +345,7 @@ PHASES = [
     ("pp-1f1b", phase_pp_1f1b),
     ("3d-dp-tp-pp", phase_3d_dp_tp_pp),
     ("3d-dp-cp-tp", phase_3d_dp_cp_tp),
+    ("cp-long-context-16k", phase_cp_long_context),
     ("ep-moe", phase_ep_moe),
     ("pallas-ring-allreduce", phase_pallas_ring_allreduce),
 ]

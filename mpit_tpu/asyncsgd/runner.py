@@ -261,18 +261,21 @@ def run_spmd(
     # Either way the choice is logged, never silent.
     use_dense = False
     if cfg.resume_dense:
-        from mpit_tpu.train import dp_from_dense, load_dense
+        import numpy as _np
 
-        dense = load_dense(cfg.resume_dense)
+        # Peek only the step for the decision (npz members load lazily);
+        # the full multi-hundred-MB dense payload is read only if it wins.
+        with _np.load(cfg.resume_dense) as _z:
+            dense_step = int(_z["__step__"])
         latest = ckpt.latest_step() if ckpt is not None else None
-        use_dense = latest is None or latest <= dense.step
+        use_dense = latest is None or latest <= dense_step
         print(
             f"[asyncsgd] restore source: "
             + (
-                f"dense {cfg.resume_dense} (step {dense.step})"
+                f"dense {cfg.resume_dense} (step {dense_step})"
                 if use_dense
                 else f"checkpoint {cfg.ckpt_dir} (step {latest} > dense "
-                f"step {dense.step})"
+                f"step {dense_step})"
             )
         )
     if use_dense:
@@ -281,7 +284,9 @@ def run_spmd(
         # same global batches. Replaces init_fn entirely — initializing a
         # full sharded state only to discard it would transiently double
         # optimizer memory.
-        state = dp_from_dense(dense, tx, world)
+        from mpit_tpu.train import dp_from_dense, load_dense
+
+        state = dp_from_dense(load_dense(cfg.resume_dense), tx, world)
     else:
         state = init_fn(params, extra)
         if ckpt is not None and ckpt.latest_step() is not None:

@@ -310,15 +310,27 @@ def _bwd_dkv_kernel(
 # ---------------------------------------------------------------------------
 
 
-def _specs(block_rows: int, hd: int):
+def _specs(block_rows: int, gd: int, ng: int):
+    """Tile spec on the packed [B, T, H·D] array: a (rows, G·D) lane
+    slice; grid index bg decomposes into (batch, head-group)."""
     return pl.BlockSpec(
-        (1, block_rows, hd), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
+        (1, block_rows, gd),
+        lambda bg, i: (bg // ng, i, bg % ng),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _full_spec(t: int, gd: int, ng: int):
+    return pl.BlockSpec(
+        (1, t, gd),
+        lambda bg, i: (bg // ng, 0, bg % ng),
+        memory_space=pltpu.VMEM,
     )
 
 
 def _row_spec(block_rows: int):
     return pl.BlockSpec(
-        (1, block_rows, _LANES), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
+        (1, block_rows, _LANES), lambda bg, i: (bg, i, 0), memory_space=pltpu.VMEM
     )
 
 
@@ -336,75 +348,79 @@ def _off(x):
     return jnp.asarray(x, jnp.int32).reshape((1,))
 
 
-def _fwd_packed(q, k, v, qoff, koff, *, h, d, causal, block_q, block_k, interpret):
-    """q/k/v ``[B, T, H·D]`` → (o ``[B, T, H·D]``, lse ``[B, T, LANES]``)."""
+def _fwd_packed(q, k, v, qoff, koff, *, g, ng, d, causal, block_q, block_k, interpret):
+    """q/k/v ``[B, T, H·D]`` → (o ``[B, T, H·D]``, lse ``[B·NG, T, LANES]``);
+    ``g`` heads per program, ``ng`` groups (g·ng = H)."""
     b, t, hd = q.shape
+    gd = g * d
     scale = 1.0 / (d ** 0.5)
-    grid = (b, t // block_q)
+    grid = (b * ng, t // block_q)
     kern = functools.partial(
         _fwd_kernel, block_k=block_k, causal=causal, scale=scale,
-        num_heads=h, head_dim=d,
-    )
-    full = pl.BlockSpec(
-        (1, t, hd), lambda b_, i: (b_, 0, 0), memory_space=pltpu.VMEM
+        num_heads=g, head_dim=d,
     )
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
-        in_specs=[_smem_scalar(), _smem_scalar(), _specs(block_q, hd), full, full],
-        out_specs=[_specs(block_q, hd), _row_spec(block_q)],
+        in_specs=[
+            _smem_scalar(), _smem_scalar(),
+            _specs(block_q, gd, ng), _full_spec(t, gd, ng), _full_spec(t, gd, ng),
+        ],
+        out_specs=[_specs(block_q, gd, ng), _row_spec(block_q)],
         out_shape=[
             jax.ShapeDtypeStruct((b, t, hd), q.dtype, vma=_vma(q)),
-            jax.ShapeDtypeStruct((b, t, _LANES), jnp.float32, vma=_vma(q)),
+            jax.ShapeDtypeStruct((b * ng, t, _LANES), jnp.float32, vma=_vma(q)),
         ],
         interpret=bool(interpret),
     )(qoff, koff, q, k, v)
     return o, lse
 
 
-def _bwd_packed(q, k, v, o, lse, do, g_lse, qoff, koff, *, h, d, causal, block_q, block_k, interpret):
-    """Packed backward. ``lse`` arrives ``[B, T, LANES]`` (head-lanes);
-    ``g_lse`` (if any) ``[B, H, T]``."""
+def _bwd_packed(q, k, v, o, lse, do, g_lse, qoff, koff, *, g, ng, d, causal, block_q, block_k, interpret):
+    """Packed backward. ``lse`` arrives ``[B·NG, T, LANES]`` (group-local
+    head lanes); ``g_lse`` (if any) ``[B, H, T]``."""
     b, t, hd = q.shape
+    h = g * ng
+    gd = g * d
     scale = 1.0 / (d ** 0.5)
     # Flash-2 delta, with the lse cotangent folded in: ∂lse/∂S = P, so a
     # direct lse cotangent g adds g·P to dS — i.e. delta → delta − g.
-    # Per-head delta straight from the packed layout: [B, T, H] — no
-    # transpose (sum over each head's lane group).
+    # Per-head delta straight from the packed layout: [B, T, H], then
+    # regrouped to group-local lanes [B·NG, T, G] (small f32 relayout).
     delta = jnp.sum(
         (do.astype(jnp.float32) * o.astype(jnp.float32)).reshape(b, t, h, d),
         axis=-1,
     )
     if g_lse is not None:
         delta = delta - g_lse.transpose(0, 2, 1)  # [B, H, T] -> [B, T, H]
-    if h < _LANES:
+    delta = (
+        delta.reshape(b, t, ng, g).transpose(0, 2, 1, 3).reshape(b * ng, t, g)
+    )
+    if g < _LANES:
         delta = jnp.concatenate(
-            [delta, jnp.zeros((b, t, _LANES - h), jnp.float32)], axis=-1
+            [delta, jnp.zeros((b * ng, t, _LANES - g), jnp.float32)], axis=-1
         )
 
-    full = lambda: pl.BlockSpec(
-        (1, t, hd), lambda b_, i: (b_, 0, 0), memory_space=pltpu.VMEM
-    )
     full_row = lambda: pl.BlockSpec(
-        (1, t, _LANES), lambda b_, i: (b_, 0, 0), memory_space=pltpu.VMEM
+        (1, t, _LANES), lambda bg, i: (bg, 0, 0), memory_space=pltpu.VMEM
     )
 
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale,
-            num_heads=h, head_dim=d,
+            num_heads=g, head_dim=d,
         ),
-        grid=(b, t // block_q),
+        grid=(b * ng, t // block_q),
         in_specs=[
             _smem_scalar(), _smem_scalar(),
-            _specs(block_q, hd),  # q tile
-            full(),  # k
-            full(),  # v
-            _specs(block_q, hd),  # do tile
-            _row_spec(block_q),  # lse tile (head lanes)
-            _row_spec(block_q),  # delta tile (head lanes)
+            _specs(block_q, gd, ng),  # q tile
+            _full_spec(t, gd, ng),  # k
+            _full_spec(t, gd, ng),  # v
+            _specs(block_q, gd, ng),  # do tile
+            _row_spec(block_q),  # lse tile (group head lanes)
+            _row_spec(block_q),  # delta tile (group head lanes)
         ],
-        out_specs=_specs(block_q, hd),
+        out_specs=_specs(block_q, gd, ng),
         out_shape=jax.ShapeDtypeStruct((b, t, hd), q.dtype, vma=_vma(q)),
         interpret=bool(interpret),
     )(qoff, koff, q, k, v, do, lse, delta)
@@ -412,19 +428,19 @@ def _bwd_packed(q, k, v, o, lse, do, g_lse, qoff, koff, *, h, d, causal, block_q
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale,
-            num_heads=h, head_dim=d,
+            num_heads=g, head_dim=d,
         ),
-        grid=(b, t // block_k),
+        grid=(b * ng, t // block_k),
         in_specs=[
             _smem_scalar(), _smem_scalar(),
-            full(),  # q
-            _specs(block_k, hd),  # k tile
-            _specs(block_k, hd),  # v tile
-            full(),  # do
+            _full_spec(t, gd, ng),  # q
+            _specs(block_k, gd, ng),  # k tile
+            _specs(block_k, gd, ng),  # v tile
+            _full_spec(t, gd, ng),  # do
             full_row(),  # lse
             full_row(),  # delta
         ],
-        out_specs=[_specs(block_k, hd), _specs(block_k, hd)],
+        out_specs=[_specs(block_k, gd, ng), _specs(block_k, gd, ng)],
         out_shape=[
             jax.ShapeDtypeStruct((b, t, hd), k.dtype, vma=_vma(q)),
             jax.ShapeDtypeStruct((b, t, hd), v.dtype, vma=_vma(q)),
@@ -444,40 +460,71 @@ def _pack(x):
     return x.reshape(b, t, h * d)  # free: contiguous view
 
 
-# v5e VMEM is ~16 MiB/core; leave headroom for scratch/accumulators.
-_VMEM_BUDGET = 13 * 2**20
+# v5e scoped VMEM is 16 MiB/core; budget leaves margin for Mosaic scratch.
+_VMEM_BUDGET = 14 * 2**20
 
 
-def _check_vmem(t, h, d, block_q, block_k, itemsize):
-    """The head-packed layout keeps ALL-heads operands resident — H× more
-    than the old per-(b,h) layout, a deliberate trade (it removed
-    21 ms/step of layout transposes) that caps single-call T. Ring
-    attention shards T, so long context belongs on the CP tier, not one
-    giant kernel call. Estimate = max over the three kernels' resident
-    sets (dq holds k+v full plus block_q-sized q/do/dq tiles; dkv holds
-    q+do full plus block_k-sized k/v/dk/dv tiles + f32 lse/delta rows)."""
-    hd = h * d
-    rows = 2 * t * _LANES * 4  # lse + delta, full f32 rows
-    resident_dq = (
-        2 * t * hd * itemsize  # k + v, full
-        + 3 * block_q * hd * itemsize  # q, do, dq tiles
-        + 2 * block_q * _LANES * 4  # lse + delta tiles
-    )
-    resident_dkv = (
-        2 * t * hd * itemsize  # q + do, full
-        + 4 * block_k * hd * itemsize  # k, v, dk, dv tiles
-        + rows
-    )
-    resident = max(resident_dq, resident_dkv)
-    if resident > _VMEM_BUDGET:
-        raise ValueError(
-            f"flash kernel: T={t} x {h} heads x D={d} needs ~"
-            f"{resident / 2**20:.1f} MiB resident VMEM (> "
-            f"{_VMEM_BUDGET / 2**20:.0f} MiB budget) in the head-packed "
-            "layout. Shard the sequence (context-parallel ring attention, "
-            "parallel/ring_attention.py) or use attention='xla' for this "
-            "shape."
+def _group_resident(t, g, d, block_q, block_k, itemsize):
+    """Estimated per-program VMEM for a ``g``-head group. EVERYTHING is
+    double-buffered across grid programs — including blocks that are
+    "full" along the row dim, since the next (batch, group) program's
+    operands prefetch while the current one computes. Calibrated against
+    two measured points: T=2048/G=12 overflows 16 MiB by ~1 MiB;
+    T=2048/G=6 overflows by 32 KiB; T=512/G=12 compiles and runs."""
+    hd = g * d
+    full_pair = 2 * 2 * t * hd * itemsize  # k+v (fwd/dq) or q+do (dkv), 2x-buffered
+    rows = 2 * 2 * t * _LANES * 4  # lse + delta full f32 rows, 2x-buffered
+    fwd_tiles = 4 * block_q * hd * itemsize * 2
+    dq_tiles = 3 * block_q * hd * itemsize * 2 + 2 * 2 * block_q * _LANES * 4
+    dkv_tiles = 4 * block_k * hd * itemsize * 2 + rows
+    score = block_q * block_k * 4 + block_q * d * 4
+    return full_pair + max(fwd_tiles, dq_tiles, dkv_tiles) + score
+
+
+def _pick_head_group(t, h, d, block_q, block_k, itemsize, interpret=False):
+    """Heads processed per kernel program. All-heads packing is fastest
+    (fewest programs, no relayouts) but its resident set grows with T;
+    when it no longer fits, fall back to head GROUPS — the block becomes
+    a lane slice ``[1, rows, G·D]`` of the packed array (still zero
+    transposes; legal when ``G·D`` is a 128-multiple). The smallest
+    usable group is the largest-T escape hatch; beyond it, shard the
+    sequence (ring attention) or use the XLA path. Interpret mode (the
+    CPU fake mesh) has no VMEM — always full-heads there."""
+    if interpret:
+        return h
+    if _group_resident(t, h, d, block_q, block_k, itemsize) <= _VMEM_BUDGET:
+        return h
+    # Usable groups: proper divisors of H whose lane width is a multiple
+    # of 128 (G = H itself is legal regardless — full-dim minor block —
+    # but it just failed the budget above).
+    candidates = [
+        g
+        for g in range(h - 1, 0, -1)
+        if h % g == 0 and (g * d) % _LANES == 0
+    ]
+    for g in candidates:
+        if _group_resident(t, g, d, block_q, block_k, itemsize) <= _VMEM_BUDGET:
+            return g
+    if candidates:
+        need = _group_resident(
+            t, candidates[-1], d, block_q, block_k, itemsize
         )
+        detail = (
+            f"needs ~{need / 2**20:.1f} MiB VMEM even at the smallest "
+            f"usable head group (G={candidates[-1]})"
+        )
+    else:
+        detail = (
+            f"has no lane-aligned head grouping (no proper divisor G of "
+            f"H={h} with G*{d} a multiple of {_LANES}) and the full-head "
+            "layout exceeds the budget"
+        )
+    raise ValueError(
+        f"flash kernel: T={t} x H={h} x D={d} {detail} (budget "
+        f"{_VMEM_BUDGET / 2**20:.0f} MiB). Shard the sequence "
+        "(context-parallel ring attention, parallel/ring_attention.py) "
+        "or use attention='xla' for this shape."
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
@@ -492,30 +539,42 @@ def _flash_fwd(q, k, v, qoff, koff, causal, block_q, block_k, interpret):
     b, t, h, d = q.shape
     if h > _LANES:
         raise ValueError(f"flash kernel supports up to {_LANES} heads, got {h}")
-    if not interpret:
-        _check_vmem(t, h, d, block_q, block_k, q.dtype.itemsize)
+    g = _pick_head_group(
+        t, h, d, block_q, block_k, q.dtype.itemsize, interpret=bool(interpret)
+    )
+    ng = h // g
     op, lsep = _fwd_packed(
         _pack(q), _pack(k), _pack(v), qoff, koff,
-        h=h, d=d, causal=causal, block_q=block_q, block_k=block_k,
+        g=g, ng=ng, d=d, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
     out = op.reshape(b, t, h, d)
-    # [B, T, LANES] head-lane store -> public [B, H, T] (tiny f32 relayout)
-    lse = lsep[:, :, :h].transpose(0, 2, 1)
+    # [B·NG, T, LANES] group-local head-lane store -> public [B, H, T]
+    # (tiny f32 relayout)
+    lse = (
+        lsep[:, :, :g]
+        .reshape(b, ng, t, g)
+        .transpose(0, 1, 3, 2)
+        .reshape(b, h, t)
+    )
     return (out, lse), (q, k, v, out, lsep, qoff, koff)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, block_q, block_k, interpret, res, g_ct):
     q, k, v, out, lsep, qoff, koff = res
-    g_o, g_lse = g
+    g_o, g_lse = g_ct
     b, t, h, d = q.shape
+    g = _pick_head_group(
+        t, h, d, block_q, block_k, q.dtype.itemsize, interpret=bool(interpret)
+    )
+    ng = h // g
     # Note: without symbolic_zeros on the custom_vjp, a discarded lse
     # output still arrives as a dense zeros cotangent — the fold below then
     # costs one elementwise subtract on [B, T, H], negligible vs attention.
     dqp, dkp, dvp = _bwd_packed(
         _pack(q), _pack(k), _pack(v), _pack(out), lsep, _pack(g_o), g_lse,
         qoff, koff,
-        h=h, d=d, causal=causal, block_q=block_q, block_k=block_k,
+        g=g, ng=ng, d=d, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
     f0 = np.zeros((1,), jax.dtypes.float0)  # int offsets: no cotangent

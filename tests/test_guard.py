@@ -267,3 +267,28 @@ class TestElasticRescaleCLI:
         assert res2["steps"] == resume_steps
         assert res2["preempted"] is False
         assert np.isfinite(res2["final_loss"])
+
+
+class TestRestoreSourceResolution:
+    """--resume-dense + --ckpt-dir resolution (restart-idempotent,
+    RECOVERY.md §4): the checkpoint wins once it progressed PAST the
+    dense step; otherwise the dense file wins. A supervisor re-running
+    the same rescale command line must keep resuming either way."""
+
+    def test_checkpoint_overtakes_dense(self, tmp_path):
+        import os
+
+        from mpit_tpu.asyncsgd import mnist as app
+
+        dense = str(tmp_path / "d.npz")
+        ck = str(tmp_path / "ck")
+        common = ["--batch-size", "32", "--lr", "0.02", "--log-every", "3",
+                  "--ckpt-dir", ck, "--ckpt-every", "3"]
+        app.main(["--steps", "6", "--save-dense", dense] + common)
+        assert os.path.exists(dense)
+        # ckpt step 6 == dense step 6 -> dense wins; run to 9 (ckpts at 9)
+        out = app.main(["--steps", "9", "--resume-dense", dense] + common)
+        assert out["steps"] == 9
+        # same command line again: ckpt step 9 > dense step 6 -> ckpt wins
+        out2 = app.main(["--steps", "12", "--resume-dense", dense] + common)
+        assert out2["steps"] == 12
