@@ -1,11 +1,37 @@
-"""Benchmark entry point — prints ONE JSON line for the driver.
+"""Benchmark entry point — prints ONE compact JSON line for the driver.
 
 Headline metric (BASELINE.json): AlexNet ImageNet images/sec, measured on
 the real SPMD training step (fwd/bwd/goo update, ZeRO-1 sharded state) on
 whatever devices are available. Secondary metrics ride in ``detail``:
-GPT-2 tokens/sec (the stretch config), the per-step ICI traffic model,
-and — when >1 device is present — measured allreduce GB/s (modeled
-otherwise, labeled as such; SURVEY.md §8.4.5).
+GPT-2 tokens/sec (the stretch config), ResNet-50 images/sec, the EP-tier
+MoE tokens/sec, and — when >1 device is present — measured allreduce
+GB/s (modeled otherwise, labeled as such; SURVEY.md §8.4.5).
+
+Driver contract (round-5 hardening — the round-3 record outgrew the
+driver's 2,000-char tail buffer and the round-4 run outgrew its time
+budget, so BOTH contract dimensions are now budgeted explicitly):
+
+* **Line budget.** The printed line carries headline value + per-workload
+  essentials only and is pinned < 1,500 chars by a unit test
+  (``tests/test_bench_contract.py``; target ≤ 1,200). Everything bulky —
+  scaling projections, comm-model assumptions, drop-rate lists — goes to
+  ``BENCH_DETAIL.json`` next to this file, which the line references.
+* **Time budget.** (a) The persistent XLA compilation cache is enabled
+  (``.jax_cache/``, verified working against this environment's axon PJRT
+  backend: a 2.3 s compile replays in 0.04 s), so driver reruns skip the
+  multi-minute compiles the build session already paid for. (b) Workloads
+  run headline-first. (c) An elapsed-time budget (``MPIT_BENCH_BUDGET_S``,
+  default 420 s) is checked before each workload; once exceeded, the rest
+  are skipped and recorded under ``"truncated"``. (d) A daemon-thread
+  watchdog 20% past the soft budget force-prints the record-so-far and
+  exits 0 (a thread, not SIGALRM: it fires even while the main thread
+  is blocked in a GIL-releasing native call — compile or device fetch).
+* **Progressive emission.** The record line is (re)printed after EVERY
+  completed workload — each print is a complete, parseable, compact
+  record of everything measured so far (later workloads listed in
+  ``"pending"``). If the driver kills the process anyway, the last
+  complete line is still inside its tail window. Only the final line
+  lacks a ``"pending"`` key.
 
 Timing methodology: each timed window ends by fetching a *host value*
 derived from the final step (``float(loss)``), not ``block_until_ready``
@@ -19,7 +45,8 @@ the round-1 ceiling). Steps therefore run in scanned chunks of K inside
 one compiled call (``make_train_step(scan_steps=K)``): every step still
 executes fully on device over distinct pre-staged batches; the wall
 clock is real; only the host round-trips between steps — pure tunnel
-artifact — are gone.
+artifact — are gone. The app-path (one dispatch per step) cross-check is
+reported alongside and is the headline (round-3 verdict item 10).
 
 ``vs_baseline``: the reference publishes no benchmark numbers
 (BASELINE.json ``"published": {}``; see BASELINE.md), so per the round-1
@@ -30,11 +57,29 @@ falls back to the recorded constants if the file is gone).
 
 from __future__ import annotations
 
+import functools
 import json
+import os
 import time
 
-import jax
-import jax.numpy as jnp
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compile cache — MUST run before the first trace."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
+_enable_compile_cache()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 
 
 def _timed_steps(step_fn, state, batches, n):
@@ -64,11 +109,7 @@ def _best_window(step_fn, state, batches, steps, repeats=3):
 def _measure(step_fn, state, batches, *, calls, scan_steps, warmup):
     """The shared timed-run scaffold (warmup, then best-of-N windows):
     every bench measures through this one path so the methodology cannot
-    drift between workloads. Returns ``(dt, steps, final_loss, state)``.
-    The app-path (unscanned) cross-check runs on the HEADLINE workload
-    only — each extra compile costs minutes of bench wall-clock on the
-    tunneled chip, and one cross-check suffices to expose a dispatch
-    regression."""
+    drift between workloads. Returns ``(dt, steps, final_loss, state)``."""
     _, _, state = _timed_steps(step_fn, state, batches, warmup)
     dt, final_loss, state = _best_window(step_fn, state, batches, calls)
     return dt, calls * scan_steps, final_loss, state
@@ -85,6 +126,44 @@ def _stack_batches(world, stream, k: int, spec=None):
     return shard_batch(world, stacked, spec=spec)
 
 
+def _device_image_batches(
+    world, *, global_batch, hw, classes, spec, k=None, seed=0
+):
+    """Synthetic image batches generated ON DEVICE (jitted jax.random with
+    explicit output shardings).
+
+    Round-5 time-budget fix: host-generating AlexNet-sized batches and
+    pushing them through this environment's tunneled device link staged
+    ~7 GB per bench run — 2/3 of the cold run's 20-minute AlexNet phase
+    was data transfer, which no compile cache helps. The timed window is
+    input-INDEPENDENT dense compute (it starts after staging), so the
+    pixels' provenance doesn't touch the measurement; uniform pixels +
+    random labels on device replace the host stream. ``k``: stack depth
+    for the scanned path (None = single unstacked batch).
+    """
+    from jax.sharding import NamedSharding
+
+    lead = () if k is None else (k,)
+    out_shardings = {
+        "image": NamedSharding(world.mesh, spec),
+        "label": NamedSharding(world.mesh, spec),
+    }
+
+    @functools.partial(jax.jit, out_shardings=out_shardings)
+    def gen(key):
+        ki, kl = jax.random.split(key)
+        return {
+            "image": jax.random.uniform(
+                ki, (*lead, global_batch, hw, hw, 3), jnp.float32
+            ),
+            "label": jax.random.randint(
+                kl, (*lead, global_batch), 0, classes, jnp.int32
+            ),
+        }
+
+    return gen(jax.random.key(seed))
+
+
 def bench_alexnet(
     batch_per_device: int = 2048,
     calls: int = 4,
@@ -97,7 +176,6 @@ def bench_alexnet(
     import mpit_tpu
     from jax.sharding import PartitionSpec as P
     from mpit_tpu import opt as gopt
-    from mpit_tpu.data import synthetic_imagenet
     from mpit_tpu.models import AlexNet
     from mpit_tpu.train import make_train_step
     from mpit_tpu.utils import CommModel
@@ -127,10 +205,15 @@ def bench_alexnet(
     # Two pre-staged stacked chunks (scan_steps distinct batches each),
     # alternated, so no step can be served from a cached/identical-input
     # artifact; successive steps still chain through the state dependency.
-    stream = synthetic_imagenet().batches(global_batch)
+    # Batches are generated ON DEVICE (_device_image_batches) — round 5
+    # removed the multi-GB host→device staging that dominated the bench's
+    # wall clock on the tunneled chip.
     batches = [
-        _stack_batches(world, stream, scan_steps, spec=P(None, "data"))
-        for _ in range(2)
+        _device_image_batches(
+            world, global_batch=global_batch, hw=224, classes=1000,
+            spec=P(None, "data"), k=scan_steps, seed=i,
+        )
+        for i in range(2)
     ]
 
     dt, steps, final_loss, state = _measure(
@@ -146,11 +229,12 @@ def bench_alexnet(
     _, app_step_fn, _ = make_train_step(
         loss_fn, gopt.goo(0.01, 0.9), world, zero1=True
     )
-    from mpit_tpu.data import shard_batch
-
     single = [
-        shard_batch(world, next(stream)),
-        shard_batch(world, next(stream)),
+        _device_image_batches(
+            world, global_batch=global_batch, hw=224, classes=1000,
+            spec=P("data"), seed=10 + i,
+        )
+        for i in range(2)
     ]
     _, _, state = _timed_steps(app_step_fn, state, single, 1)  # compile
     app_dt, _, state = _best_window(app_step_fn, state, single, 4)
@@ -174,7 +258,9 @@ def _scaling(step_seconds, items_per_chip, params):
     """The BASELINE 8→256 scaling-efficiency artifact (analytic, labeled
     ``modeled``; utils/profiling.scaling_projection). Two topologies:
     ``single_slice`` (up to 256 chips of ICI — one v5e pod) and
-    ``slice64`` (64-chip slices joined by DCN — the cross-slice cliff)."""
+    ``slice64`` (64-chip slices joined by DCN — the cross-slice cliff).
+    Detail-file-only: these blobs are what overflowed the driver's tail
+    buffer in round 3."""
     from mpit_tpu.utils import scaling_projection
 
     return {
@@ -205,7 +291,6 @@ def bench_resnet(
     import mpit_tpu
     from jax.sharding import PartitionSpec as P
     from mpit_tpu import opt as gopt
-    from mpit_tpu.data import synthetic_imagenet
     from mpit_tpu.models import ResNet50
     from mpit_tpu.train import make_train_step
 
@@ -240,10 +325,12 @@ def bench_resnet(
         scan_steps=scan_steps,
     )
     state = init_fn(params, batch_stats)
-    stream = synthetic_imagenet().batches(global_batch)
     batches = [
-        _stack_batches(world, stream, scan_steps, spec=P(None, "data"))
-        for _ in range(2)
+        _device_image_batches(
+            world, global_batch=global_batch, hw=224, classes=1000,
+            spec=P(None, "data"), k=scan_steps, seed=i,
+        )
+        for i in range(2)
     ]
 
     dt, steps, final_loss, state = _measure(
@@ -346,7 +433,7 @@ def bench_gpt2(calls: int = 3, scan_steps: int = 8, warmup: int = 1, seq: int = 
     }
 
 
-def bench_moe(calls: int = 4, warmup: int = 1, seq: int = 512, batch_per_device: int = 16):
+def bench_moe(calls: int = 4, warmup: int = 1, seq: int = 512, batch_per_device: int = 32):
     """GPT-2-MoE throughput on the EP TIER ITSELF (round-3 verdict item
     4): ``parallel/ep.py``'s train step — routed dispatch, capacity
     drops, per-placement-group flat ravel, and ZeRO-1 ON (the round-3
@@ -357,11 +444,11 @@ def bench_moe(calls: int = 4, warmup: int = 1, seq: int = 512, batch_per_device:
     8 experts, top-2, cf=1.25, MoE every 2nd block. Dispatch/drop stats
     come from the model's sown ``dispatch_stats`` on a probe forward
     (high drop rates are expected here: the router is at random init).
-    Sizing: the einsum dispatch's [S, E, C] one-hot grows ~quadratically
-    in per-device tokens (C ~ S·k/E), so B/device is capped at 16 for
-    T=512 on the 16 GB chip — measured: B=32 OOMs, B=16 runs at ~46k
-    tok/s; pod-scale EP keeps per-device S small by sharding batch over
-    data x expert.
+
+    Round 5: the sort (ragged scatter/gather) dispatch replaced the
+    one-hot einsum as the default — the [S, E, C] tensors that OOMed
+    B=32/T=512 on the 16 GB chip (round-4 cap at B=16) no longer exist,
+    so the tier now measures at B=32 (parallel/moe.py docstring).
     """
     import mpit_tpu
     from jax.sharding import PartitionSpec as P
@@ -376,7 +463,16 @@ def bench_moe(calls: int = 4, warmup: int = 1, seq: int = 512, batch_per_device:
     batch = batch_per_device * n
     zero1 = True
 
-    cfg = GPT2Config.small(max_seq_len=seq, head_dtype=jnp.bfloat16)
+    kw = dict(max_seq_len=seq, head_dtype=jnp.bfloat16)
+    if jax.devices()[0].platform == "tpu" and seq >= 512:
+        # Same rule as bench_gpt2: the Pallas flash kernel from T=512 up.
+        # Round 5: without it the XLA attention saves [B,H,T,T] scores
+        # for backward (~2.4 GB at B=32/T=512) — the other half of the
+        # B=32 memory story next to the sort dispatch + expert remat.
+        from mpit_tpu.ops import flash_attention
+
+        kw["attention_fn"] = flash_attention
+    cfg = GPT2Config.small(**kw)
     moe = MoESettings(num_experts=8, k=2, capacity_factor=1.25, every=2)
     model = GPT2MoE(cfg, moe)
     params = jax.jit(model.init)(
@@ -421,6 +517,7 @@ def bench_moe(calls: int = 4, warmup: int = 1, seq: int = 512, batch_per_device:
         "tokens_per_sec": round(batch * seq * steps / dt, 1),
         "ms_per_step": round(dt / steps * 1e3, 2),
         "tier": "ep",
+        "dispatch": moe.dispatch,
         "batch": batch,
         "seq_len": seq,
         "experts": moe.num_experts,
@@ -488,10 +585,8 @@ def _round1_baselines():
     protocol ("the measured single-chip numbers are the cross-round
     baseline now", VERDICT.md round 1). Read from BENCH_r01.json so a
     corrected record propagates; constants are the fallback."""
-    import os
-
     alex, gpt2 = 18007.75, 66687.0
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_r01.json")
+    path = os.path.join(_REPO, "BENCH_r01.json")
     try:
         with open(path) as f:
             rec = json.load(f)["parsed"]
@@ -502,49 +597,173 @@ def _round1_baselines():
     return alex, gpt2
 
 
-def main():
-    alex = bench_alexnet()
-    resnet = bench_resnet()
-    gpt2 = bench_gpt2()
-    try:
-        moe = bench_moe()
-    except Exception as e:  # a secondary entry must not kill the artifact
-        moe = {"error": f"{type(e).__name__}: {e}"[:300]}
-    ar = bench_allreduce()
-    r1_alex, r1_gpt2 = _round1_baselines()
-    # Headline = the APP-PATH number (round-3 verdict item 10): what the
-    # training loop actually delivers, one host dispatch per step. The
-    # scanned number stays in detail. vs_baseline keeps the round-1
-    # scanned recording as its denominator (the only cross-round
-    # constant), so it reads as "app path now vs headline then" — the
-    # honest direction of drift.
-    print(
-        json.dumps(
-            {
-                "metric": "alexnet_imagenet_app_path_images_per_sec",
-                "value": alex["app_path_images_per_sec"],
-                "unit": "images/sec",
-                "vs_baseline": round(
-                    alex["app_path_images_per_sec"] / r1_alex, 3
-                ),
-                "detail": {
-                    "devices": jax.device_count(),
-                    "platform": jax.devices()[0].platform,
-                    "alexnet": alex,
-                    "resnet50": resnet,
-                    "gpt2": {
-                        **gpt2,
-                        "vs_r1": round(gpt2["tokens_per_sec"] / r1_gpt2, 3),
-                        "vs_r1_app_path": round(
-                            gpt2["app_path_tokens_per_sec"] / r1_gpt2, 3
-                        ),
-                    },
-                    "gpt2_moe": moe,
-                    "allreduce": ar,
-                },
-            }
+# ---------------------------------------------------------------------------
+# Driver-contract record building (unit-tested: tests/test_bench_contract.py)
+# ---------------------------------------------------------------------------
+
+# Per-workload keys that ride ON THE LINE; everything else detail-file-only.
+_LINE_KEYS = {
+    "alexnet": (
+        "images_per_sec", "app_path_images_per_sec", "ms_per_step",
+        "global_batch", "final_loss", "error",
+    ),
+    "resnet50": (
+        "images_per_sec", "ms_per_step", "global_batch", "final_loss",
+        "error",
+    ),
+    "gpt2": (
+        "tokens_per_sec", "app_path_tokens_per_sec", "ms_per_step", "batch",
+        "seq_len", "attention", "final_loss", "error",
+    ),
+    "gpt2_moe": (
+        "tokens_per_sec", "ms_per_step", "batch", "seq_len", "dispatch",
+        "final_loss", "error",
+    ),
+    "allreduce": ("gbps", "modeled", "devices", "error"),
+}
+
+
+def build_record(results: dict, pending=(), truncated=(), elapsed_s=None,
+                 baselines=None):
+    """The compact driver record: headline + per-workload essentials.
+
+    ``results`` maps workload name → the full dict its bench_* returned
+    (absent = not run). The full dicts belong in BENCH_DETAIL.json; this
+    record is the ≤1,200-char line. Pure function of its inputs so the
+    contract test can pin the line length with canned numbers.
+    """
+    r1_alex, r1_gpt2 = baselines if baselines else _round1_baselines()
+    detail = {}
+    for name, keys in _LINE_KEYS.items():
+        if name in results:
+            full = results[name]
+            detail[name] = {k: full[k] for k in keys if k in full}
+    gpt2 = detail.get("gpt2")
+    if gpt2 and "tokens_per_sec" in gpt2:
+        gpt2["vs_r1"] = round(gpt2["tokens_per_sec"] / r1_gpt2, 3)
+        gpt2["vs_r1_app_path"] = round(
+            gpt2["app_path_tokens_per_sec"] / r1_gpt2, 3
         )
-    )
+    alex = results.get("alexnet", {})
+    value = alex.get("app_path_images_per_sec")
+    rec = {
+        # Headline = the APP-PATH number (round-3 verdict item 10): what
+        # the training loop actually delivers, one host dispatch per step.
+        # vs_baseline keeps the round-1 scanned recording as denominator
+        # (the only cross-round constant): "app path now vs headline then".
+        "metric": "alexnet_imagenet_app_path_images_per_sec",
+        "value": value,
+        "unit": "images/sec",
+        "vs_baseline": round(value / r1_alex, 3) if value else None,
+        "detail": detail,
+    }
+    if elapsed_s is not None:
+        rec["elapsed_s"] = round(elapsed_s, 1)
+    if pending:
+        rec["pending"] = list(pending)
+    if truncated:
+        rec["truncated"] = list(truncated)
+    rec["detail_file"] = "BENCH_DETAIL.json"
+    return rec
+
+
+class _Emitter:
+    """Writes BENCH_DETAIL.json + prints the compact line after every
+    completed workload, so a driver kill at ANY point leaves the last
+    complete record inside its 2,000-char tail window."""
+
+    def __init__(self, t0: float):
+        self.t0 = t0
+        self.results: dict = {}
+        self.truncated: list = []
+        self.platform = jax.devices()[0].platform
+        self.devices = jax.device_count()
+
+    def emit(self, pending=()):
+        elapsed = time.perf_counter() - self.t0
+        rec = build_record(
+            self.results, pending=pending, truncated=self.truncated,
+            elapsed_s=elapsed,
+        )
+        rec["detail"]["devices"] = self.devices
+        rec["detail"]["platform"] = self.platform
+        try:
+            with open(os.path.join(_REPO, "BENCH_DETAIL.json"), "w") as f:
+                json.dump(
+                    {
+                        "elapsed_s": round(elapsed, 1),
+                        "devices": self.devices,
+                        "platform": self.platform,
+                        "pending": list(pending),
+                        "truncated": self.truncated,
+                        "workloads": self.results,
+                    },
+                    f,
+                    indent=1,
+                )
+        except OSError as e:
+            rec["detail_file_error"] = str(e)[:80]
+        line = json.dumps(rec)
+        print(line, flush=True)
+        return line
+
+
+def main():
+    t0 = time.perf_counter()
+    budget = float(os.environ.get("MPIT_BENCH_BUDGET_S", "420"))
+    em = _Emitter(t0)
+
+    # Headline-first ordering; each entry = (name, fn). The modeled
+    # allreduce figure is free, so it rides along from the start.
+    workloads = [
+        ("allreduce", bench_allreduce),
+        ("alexnet", bench_alexnet),
+        ("gpt2", bench_gpt2),
+        ("resnet50", bench_resnet),
+        ("gpt2_moe", bench_moe),
+    ]
+
+    def _watchdog():
+        # Hard stop: force out the record-so-far and exit clean — runs
+        # on a daemon thread so it fires even while the main thread is
+        # blocked in a GIL-RELEASING native call (XLA compiles and
+        # device fetches, the two ways a workload actually gets stuck
+        # here). A native loop that held the GIL would still block it,
+        # but then nothing in-process could run; progressive emission
+        # (the already-printed lines in the driver's tail) is the
+        # backstop for that case.
+        remaining = [n for n, _ in workloads if n not in em.results]
+        em.truncated.extend(
+            n for n in remaining if n not in em.truncated
+        )
+        em.emit()
+        os._exit(0)
+
+    import threading
+
+    watchdog = threading.Timer(budget * 1.2 + 30, _watchdog)
+    watchdog.daemon = True
+    watchdog.start()
+
+    for i, (name, fn) in enumerate(workloads):
+        elapsed = time.perf_counter() - t0
+        if elapsed > budget:
+            em.truncated.extend(n for n, _ in workloads[i:])
+            break
+        t_w = time.perf_counter()
+        try:
+            em.results[name] = fn()
+        except Exception as e:  # one workload must not kill the artifact
+            em.results[name] = {
+                "error": f"{type(e).__name__}: {e}"[:200]
+            }
+        # Wall seconds the workload took end to end (compile + staging +
+        # measurement) — the time-budget diagnostic; detail-file only.
+        em.results[name]["wall_s"] = round(time.perf_counter() - t_w, 1)
+        em.emit(pending=[n for n, _ in workloads[i + 1:]])
+
+    watchdog.cancel()
+    em.emit()
 
 
 if __name__ == "__main__":

@@ -159,7 +159,9 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             from mpit_tpu.train import CheckpointManager
 
             ckpt = CheckpointManager(cfg.ckpt_dir, world, async_save=False)
-            ckpt.ensure_meta(runner.run_meta(cfg))
+            ckpt.ensure_meta(
+                runner.run_meta(cfg), defaults=runner.run_meta(type(cfg)())
+            )
             if ckpt.latest_step() is not None:
                 state = ckpt.restore(state, specs_fn(params))
                 # Seek-based resume: rebuild the stream fast-forwarded

@@ -135,20 +135,46 @@ def run_meta(cfg: TrainConfig) -> dict:
     (or a different cwd) compares correctly. ``stream_impl`` records the
     *resolved* stream — the C++ core's RNG stream differs from the
     Python fallback's, so resuming a native-core run on a host where the
-    core is unavailable must be rejected, not silently fall back
-    (``--native`` with a file dataset or an unbuilt core runs the Python
-    path on both sides, so only the synthetic native core pins).
+    core is unavailable must be rejected, not silently fall back. The
+    core draws RNG in two cases: the synthetic native stream, and a file
+    dataset whose rrc augmentation routes through ``mpit_rrc_batch``
+    (``FileClassification.native_batches``) — both pin ``native_core``;
+    an unbuilt core runs the Python path on both sides and pins
+    ``python`` (round-4 advisor: the file+rrc case previously recorded
+    ``python`` while drawing from the C++ stream, so a host without the
+    native build could silently change the augmentation stream
+    mid-trajectory).
     Workload-specific config fields (everything a ``TrainConfig``
     subclass adds: model hyperparameters, loss/numerics flags) are
     pinned wholesale — shape-preserving drift like gpt2 ``num_heads`` or
     ``moe_k`` restores cleanly through orbax and would otherwise
     silently change the function being resumed."""
+    import json
     import os
 
     from mpit_tpu.data import native as native_mod
 
+    def _is_classification_dir(d: str) -> bool:
+        # Only FileClassification.native_batches routes through the C++
+        # core (rrc augmentation); FileLM's is pure Python — an LM run
+        # with stray rrc flags must NOT pin native_core (round-4 review).
+        try:
+            with open(os.path.join(d, "meta.json")) as f:
+                return json.load(f).get("kind") == "classification"
+        except (OSError, ValueError):
+            return False
+
     uses_native_core = (
-        cfg.native and not cfg.data_dir and native_mod.available()
+        cfg.native
+        and native_mod.available()
+        and (
+            not cfg.data_dir
+            or (
+                cfg.augment
+                and cfg.augment_mode == "rrc"
+                and _is_classification_dir(cfg.data_dir)
+            )
+        )
     )
     meta = {
         **gopt.schedules.geometry(cfg),
@@ -239,18 +265,30 @@ def run_spmd(
         loss_fn, tx, world, axis=axis, zero1=cfg.zero1, stateful=stateful
     )
 
-    if (cfg.resume_dense or cfg.save_dense) and (not cfg.zero1 or stateful):
+    if (cfg.resume_dense or cfg.save_dense) and (
+        not cfg.zero1 or stateful or jax.process_count() > 1
+    ):
         # Fail before any training happens: the dense format carries the
-        # ZeRO-1 DP layout and no stateful extras (BatchNorm stats).
+        # ZeRO-1 DP layout, no stateful extras (BatchNorm stats), and a
+        # single-controller gather/scatter (train/convert.py) — a
+        # multi-process run would otherwise train to completion and only
+        # then crash in dense_from_dp without writing the artifact
+        # (round-4 advisor finding).
         raise SystemExit(
             "--resume-dense/--save-dense convert the ZeRO-1 DP layout; "
-            "run with --zero1 true and a stateless model (BatchNorm "
-            "models use same-geometry --ckpt-dir resume)"
+            "run with --zero1 true, a stateless model (BatchNorm "
+            "models use same-geometry --ckpt-dir resume), and a single "
+            "controller process (multi-host runs checkpoint via "
+            "--ckpt-dir)"
         )
     ckpt = None
     if cfg.ckpt_dir:
         ckpt = CheckpointManager(cfg.ckpt_dir, world)
-        ckpt.ensure_meta(run_meta(cfg))
+        # ``defaults``: what a default-configured run of this workload
+        # would record — lets ensure_meta warn when a field the recorded
+        # meta predates is being pinned at a NON-default value (drift
+        # against the original run is unvalidatable; see ensure_meta).
+        ckpt.ensure_meta(run_meta(cfg), defaults=run_meta(type(cfg)()))
 
     # Restore-source resolution (restart-idempotent: a preemption
     # supervisor may re-run the SAME rescale command line — see RECOVERY
@@ -395,6 +433,9 @@ def run_spmd(
         "restores": result["restores"],
         "preempted": result["preempted"],
     }
+    for k in ("items_per_sec", "items_per_sec_last"):
+        if k in result:  # e2e throughput (loop.py best-logged-window)
+            out[k] = result[k]
     if "eval" in result:
         # The last full-val-split sweep (the authoritative number).
         out["eval"] = result["eval"]

@@ -18,7 +18,15 @@ otherwise.
 
 from mpit_tpu.models.lenet import LeNet
 from mpit_tpu.models.alexnet import AlexNet
+from mpit_tpu.models.norm import ScaleShiftBatchNorm
 from mpit_tpu.models.resnet import ResNet50
 from mpit_tpu.models.gpt2 import GPT2, GPT2Config
 
-__all__ = ["LeNet", "AlexNet", "ResNet50", "GPT2", "GPT2Config"]
+__all__ = [
+    "LeNet",
+    "AlexNet",
+    "ResNet50",
+    "GPT2",
+    "GPT2Config",
+    "ScaleShiftBatchNorm",
+]

@@ -41,6 +41,9 @@ class MoESettings:
     # (flax validates declared shapes, and inside shard_map the leaves
     # arrive as local shards). 1 = dense layout (init + single device).
     shards: int = 1
+    # Dispatch backend (parallel/moe.py): "sort" (ragged scatter/gather,
+    # the memory-scalable default) or "einsum" (the one-hot oracle).
+    dispatch: str = "sort"
 
 
 class MoEBlock(nn.Module):
@@ -89,6 +92,7 @@ class MoEBlock(nn.Module):
             axis=moe.axis_name,
             reduce_aux=moe.reduce_aux,
             with_stats=True,
+            dispatch=moe.dispatch,
         )
         # Routing observability (bench/eval read it via
         # ``apply(..., mutable=["intermediates"])``; dead-code-eliminated

@@ -1,0 +1,103 @@
+"""Scale-shift BatchNorm — the TPU BN-train recipe (round-5 ResNet lever).
+
+Round-4 tracing (BENCHMARKS.md §ResNet-50) attributed ~67 ms of the
+111 ms ResNet-50 step to the BN-train chain (statistics + unfused
+elementwise/convert traffic around flax's ``nn.BatchNorm``), vs ~27 ms
+of actual convolution. This module is the classic production fix
+(cf. the MLPerf TPU ResNet recipe): algebraically identical BN with the
+tensor-sized work reduced to the minimum XLA can schedule —
+
+- **One-pass sufficient statistics**: per-channel ``Σx`` and ``Σx²`` in
+  a single f32-accumulating reduce over the bf16 activations (the
+  convert fuses into the reduce read); mean/var are derived [C]-sized
+  math.
+- **Single fused scale-shift**: the normalize+affine collapses to
+  ``x·a + b`` with per-channel ``a = γ·rsqrt(σ²+ε)`` and
+  ``b = β − μ·a`` precomputed in f32 and applied in the activation
+  dtype — ONE elementwise FMA over the tensor, which XLA fuses with the
+  neighboring relu/residual-add. flax's formulation keeps μ/σ as f32
+  broadcasts, promoting every elementwise step of the big tensor to f32.
+- The backward pass AD derives from this forward is the standard
+  two-reduction BN gradient over bf16 operands — no f32 tensor copies.
+
+Interface-compatible with ``nn.BatchNorm`` where the ResNet uses it:
+``scale``/``bias`` params and ``batch_stats.{mean,var}`` running
+averages with identical shapes/dtypes/semantics (momentum EMA, biased
+variance, ``use_running_average`` eval path); the flax module remains
+the parity oracle (``tests/test_models.py``: outputs, stats, gradients,
+cross-replica psum, and a rename-keys checkpoint transplant into the
+full ResNet — the auto-derived module names are the ONLY layout
+difference between the two implementations).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class ScaleShiftBatchNorm(nn.Module):
+    """Drop-in ``nn.BatchNorm`` for the channels-last training path.
+
+    Args mirror the ``nn.BatchNorm`` subset the models use. ``dtype`` is
+    the output/compute dtype of the tensor-sized work (the [C]-sized
+    statistics math is always f32). ``axis_name`` syncs batch statistics
+    across a mapped axis (cross-replica BN) via ``psum`` of the
+    sufficient statistics.
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.99
+    epsilon: float = 1e-5
+    dtype: Any = None
+    axis_name: str | None = None
+    scale_init: Callable = nn.initializers.ones
+    bias_init: Callable = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        reduce_axes = tuple(range(x.ndim - 1))
+        scale = self.param("scale", self.scale_init, (c,), jnp.float32)
+        bias = self.param("bias", self.bias_init, (c,), jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((c,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((c,), jnp.float32)
+        )
+
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            n = x.size // c
+            # One pass over the tensor: both sufficient statistics ride
+            # the same (f32-accumulating) reduce fusion.
+            xf = x.astype(jnp.float32)
+            s1 = jnp.sum(xf, axis=reduce_axes)
+            s2 = jnp.sum(lax.square(xf), axis=reduce_axes)
+            if self.axis_name is not None:
+                s1 = lax.psum(s1, self.axis_name)
+                s2 = lax.psum(s2, self.axis_name)
+                n = n * lax.axis_size(self.axis_name)
+            mean = s1 / n
+            # Biased ("fast") variance, clipped: E[x²]−E[x]² can go
+            # slightly negative in finite precision.
+            var = jnp.maximum(s2 / n - lax.square(mean), 0.0)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
+                ra_var.value = m * ra_var.value + (1.0 - m) * var
+
+        inv = lax.rsqrt(var + self.epsilon) * scale
+        a = inv
+        b = bias - mean * inv
+        out_dtype = self.dtype or x.dtype
+        # The whole tensor-sized normalize is this one FMA (plus whatever
+        # relu/residual-add XLA fuses around it) in the compute dtype.
+        y = x.astype(out_dtype) * a.astype(out_dtype) + b.astype(out_dtype)
+        return y

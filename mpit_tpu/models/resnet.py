@@ -34,12 +34,14 @@ from typing import Any, Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from mpit_tpu.models.norm import ScaleShiftBatchNorm
+
 
 class Bottleneck(nn.Module):
     filters: int
     strides: int = 1
     dtype: Any = jnp.bfloat16
-    norm: Any = nn.BatchNorm
+    norm: Any = ScaleShiftBatchNorm
     norm_dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -72,6 +74,10 @@ class ResNet50(nn.Module):
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     norm_dtype: Any = jnp.bfloat16
     stem: str = "s2d"  # "s2d" (TPU recipe) | "conv7" (classic)
+    # BN implementation: ScaleShiftBatchNorm (models/norm.py — the
+    # round-5 BN-train lever, measured in BENCHMARKS.md) or
+    # nn.BatchNorm (the flax oracle; identical math, parity-tested).
+    norm: Any = ScaleShiftBatchNorm
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -100,7 +106,7 @@ class ResNet50(nn.Module):
                 use_bias=False, dtype=self.dtype,
             )(x)
         x = nn.relu(
-            nn.BatchNorm(
+            self.norm(
                 use_running_average=not train, dtype=self.norm_dtype
             )(x)
         )
@@ -110,7 +116,7 @@ class ResNet50(nn.Module):
                 strides = 2 if stage > 0 and block == 0 else 1
                 x = Bottleneck(
                     64 * 2**stage, strides=strides, dtype=self.dtype,
-                    norm_dtype=self.norm_dtype,
+                    norm=self.norm, norm_dtype=self.norm_dtype,
                 )(x, train=train)
         x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)

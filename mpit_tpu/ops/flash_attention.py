@@ -463,6 +463,12 @@ def _pack(x):
 # v5e scoped VMEM is 16 MiB/core; budget leaves margin for Mosaic scratch.
 _VMEM_BUDGET = 14 * 2**20
 
+# Sweep-validation hook (sweep_flash_vmem.py / tests/test_ops.py): force a
+# specific head group instead of the estimator's choice, so the real
+# compiler can be asked "does the group the estimator REJECTED actually
+# overflow?". Never set outside those harnesses.
+_GROUP_OVERRIDE: int | None = None
+
 
 def _group_resident(t, g, d, block_q, block_k, itemsize):
     """Estimated per-program VMEM for a ``g``-head group. EVERYTHING is
@@ -492,6 +498,8 @@ def _pick_head_group(t, h, d, block_q, block_k, itemsize, interpret=False):
     CPU fake mesh) has no VMEM — always full-heads there."""
     if interpret:
         return h
+    if _GROUP_OVERRIDE is not None:
+        return _GROUP_OVERRIDE
     if _group_resident(t, h, d, block_q, block_k, itemsize) <= _VMEM_BUDGET:
         return h
     # Usable groups: proper divisors of H whose lane width is a multiple

@@ -65,6 +65,7 @@ from mpit_tpu.parallel.moe import (
     expert_parallel_moe,
     moe_capacity,
     top_k_dispatch,
+    top_k_routes,
 )
 from mpit_tpu.parallel.threed import (
     make_gpt2_dp_cp_tp_train_step,
@@ -113,4 +114,5 @@ __all__ = [
     "dispatch_stats",
     "moe_capacity",
     "top_k_dispatch",
+    "top_k_routes",
 ]

@@ -46,7 +46,7 @@ class CheckpointManager:
             ),
         )
 
-    def ensure_meta(self, meta: dict) -> None:
+    def ensure_meta(self, meta: dict, *, defaults: dict | None = None) -> None:
         """Pin run geometry to the checkpoint directory.
 
         While the directory holds a restorable checkpoint, every run
@@ -59,6 +59,16 @@ class CheckpointManager:
         or a run that died before its first save) the guarantee is
         vacuous, so the meta is (re)written instead of validated. Only
         process 0 writes (orbax convention); every process validates.
+
+        ``defaults``: the meta a default-configured run would record
+        (runner passes ``run_meta(type(cfg)())``). Used when merging
+        fields the recorded meta predates: a newly-added field pinned at
+        its default is benign (the original run implicitly ran the
+        default), but a NON-default value cannot be validated against
+        the original run — it is merged with a warning, like the
+        no-meta path (round-4 advisor finding: resuming a pre-
+        ``train_size`` checkpoint with ``--train-size 16`` silently
+        changed data geometry and recorded 16 as if always so).
         """
         path = self._dir / "run_meta.json"
         if path.exists() and self.latest_step() is not None:
@@ -93,6 +103,24 @@ class CheckpointManager:
             # full field set instead of leaving them unvalidated forever
             # (round-3 advisor finding).
             unrecorded = {k: v for k, v in meta.items() if k not in recorded}
+            nondefault = {
+                k: v
+                for k, v in unrecorded.items()
+                if defaults is not None and v != defaults.get(k)
+            }
+            if nondefault:
+                import warnings
+
+                fields = ", ".join(
+                    f"{k}={v!r}" for k, v in sorted(nondefault.items())
+                )
+                warnings.warn(
+                    f"{self._dir} predates geometry field(s) {fields}; "
+                    "pinning this run's non-default value(s) — drift "
+                    "against the run that wrote the checkpoint (which "
+                    "implicitly ran the old default) cannot be validated",
+                    stacklevel=2,
+                )
             if unrecorded and jax.process_index() == 0:
                 merged = {**recorded, **unrecorded}
                 tmp = path.with_suffix(".json.tmp")

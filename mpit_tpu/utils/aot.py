@@ -18,6 +18,17 @@ TPU-native upgrade: the compiler is a queryable model of the machine.
 
 Used by ``compile_multichip.py`` (repo root, driver-runnable) and the
 ``tests/test_aot.py`` memory-regression tests.
+
+Known limitation (round-5): ``jax.experimental.topologies`` describes a
+single ICI-connected slice — there is no public topology spec for a
+multi-slice (DCN-joined) system, so true cross-slice programs cannot be
+AOT-compiled as such. The hybrid-mesh phase therefore compiles the
+slice-major program against VIRTUAL slices (contiguous halves of one
+real topology, ``comm.mesh._slice_groups``'s documented fallback): mesh
+layout, collective decomposition, and memory are those of the
+multi-slice program; DCN link characteristics are invisible to the
+compiler either way (it prices collectives by topology, not by
+measured link speed).
 """
 
 from __future__ import annotations

@@ -18,6 +18,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+# Every test here drives the real TPU compiler against a topology -
+# minutes of compile wall-clock; full-suite tier only.
+pytestmark = pytest.mark.slow
 from jax.sharding import PartitionSpec as P
 
 

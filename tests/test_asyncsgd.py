@@ -78,6 +78,7 @@ class TestMnist:
 
 
 class TestImagenet:
+    @pytest.mark.slow
     def test_spmd_micro_runs(self):
         out = imagenet.main(
             ["--steps", "4", "--batch-size", "16", "--image-size", "64",
@@ -98,6 +99,7 @@ class TestImagenet:
 
 
 class TestResnet:
+    @pytest.mark.slow
     def test_spmd_stateful_micro_runs(self):
         out = resnet.main(
             ["--steps", "3", "--batch-size", "16", "--image-size", "32",
@@ -124,6 +126,7 @@ class TestGPT2:
         assert out["tier"] == "shard_map+zero1"
         assert out["final_loss"] < out["uniform_loss"] + 0.05
 
+    @pytest.mark.slow
     def test_pjit_tp_tier_matches_dp(self):
         dp = gpt2.main(["--steps", "8", *self.TINY])
         tp = gpt2.main(["--steps", "8", "--mesh", "data=4,model=2", *self.TINY])

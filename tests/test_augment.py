@@ -205,6 +205,7 @@ class TestValSweep:
         assert logged.count("eval_top1") >= 2  # steps 10 and 20
 
 
+@pytest.mark.slow
 class TestAugmentationImprovesAccuracy:
     def test_shifted_val_fixture(self, tmp_path):
         """E2E (round-2 verdict item 1 'done' criterion): on a fixture
@@ -405,6 +406,7 @@ class TestImageDirectoryImport:
         ds = load_dataset(out)
         assert len(ds) == 18 and ds.val_size == 6  # 2 of 8 per class held out
 
+    @pytest.mark.slow
     def test_e2e_train_from_jpeg_directory(self, tmp_path):
         """Round-3 verdict item 8 'done' criterion: the imagenet workload
         trains end-to-end from a directory of generated JPEGs through
@@ -427,6 +429,7 @@ class TestImageDirectoryImport:
         assert res["eval"]["top1"] > 0.7
 
 
+@pytest.mark.slow
 class TestRRCImprovesAccuracy:
     def test_zoom_jittered_val_fixture(self, tmp_path):
         """RRC e2e (round-3 verdict item 8): the val split shows ZOOMED

@@ -243,6 +243,7 @@ class TestEagerTier:
         np.testing.assert_array_equal(np.asarray(got), np.full((1, 3), n))
 
 
+@pytest.mark.slow
 class TestMultiHostBootstrap:
     """Round-3 verdict item 6: the multi-host bootstrap path
     (``mesh.py::_maybe_distributed_initialize``) actually executed — 2 OS

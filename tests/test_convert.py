@@ -83,6 +83,7 @@ def _dp_loss_fn():
     return loss_fn
 
 
+@pytest.mark.slow
 class TestCrossTierRestore:
     def test_dp_to_3d_and_back_matches_dense(self):
         """DP 4 steps → 3-D mesh 4 steps → DP 2 steps, every switch via

@@ -88,6 +88,7 @@ class TestRecoveryIntegration:
             self._run(tmp_path, poison_step=5, max_restores=0)
 
 
+@pytest.mark.slow
 class TestPreemptionDrain:
     """RECOVERY.md §2: SIGTERM → finish step → checkpoint → clean exit →
     resume matches the uninterrupted trajectory."""
@@ -199,6 +200,7 @@ class TestPreemptionDrain:
         assert out2["preempted"] is False
 
 
+@pytest.mark.slow
 class TestElasticRescaleCLI:
     """RECOVERY.md §4 e2e (round-3 verdict item 7): SIGTERM an 8-device
     run that writes the geometry-free dense .npz on drain, then resume it

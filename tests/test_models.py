@@ -1,0 +1,155 @@
+"""Model-layer units: the ScaleShiftBatchNorm ↔ nn.BatchNorm parity
+contract (round-5 ResNet BN-train lever; models/norm.py docstring).
+
+The scale-shift module claims ALGEBRAIC identity with flax BatchNorm
+(one-pass E[x²]−E[x]² statistics, biased variance, momentum EMA, same
+param/stat names) — these tests pin that claim, in f32 where the match
+is tight and in the bf16 production configuration where only rounding
+differs, plus the end-to-end swap inside ResNet-50.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpit_tpu.models import ResNet50, ScaleShiftBatchNorm
+
+
+def _both(x, *, train, dtype=jnp.float32, variables=None):
+    outs = []
+    for cls in (nn.BatchNorm, ScaleShiftBatchNorm):
+        m = cls(use_running_average=not train, dtype=dtype)
+        v = variables or m.init(jax.random.key(0), x)
+        if train:
+            y, mut = m.apply(v, x, mutable=["batch_stats"])
+            outs.append((y, mut["batch_stats"]))
+        else:
+            outs.append((m.apply(v, x), None))
+    return outs
+
+
+class TestScaleShiftBatchNorm:
+    def test_train_forward_and_stats_match_flax(self):
+        x = jax.random.normal(jax.random.key(1), (8, 6, 6, 16)) * 3 + 1.5
+        (y1, s1), (y2, s2) = _both(x, train=True)
+        np.testing.assert_allclose(
+            np.asarray(y1), np.asarray(y2), rtol=1e-5, atol=1e-5
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            s1,
+            s2,
+        )
+
+    def test_eval_forward_matches_flax(self):
+        x = jax.random.normal(jax.random.key(2), (4, 5, 5, 8))
+        # Non-trivial running stats: train once, then eval through both.
+        m = ScaleShiftBatchNorm()
+        v = m.init(jax.random.key(0), x)
+        _, mut = m.apply(v, x, mutable=["batch_stats"])
+        v = {"params": v["params"], **mut}
+        (y1, _), (y2, _) = _both(x, train=False, variables=v)
+        np.testing.assert_allclose(
+            np.asarray(y1), np.asarray(y2), rtol=1e-5, atol=1e-6
+        )
+
+    def test_bf16_production_config_close(self):
+        x = (
+            jax.random.normal(jax.random.key(3), (16, 8, 8, 32)) * 2
+        ).astype(jnp.bfloat16)
+        (y1, s1), (y2, s2) = _both(x, train=True, dtype=jnp.bfloat16)
+        assert y2.dtype == jnp.bfloat16
+        # bf16 rounding differs between the two formulations (flax
+        # normalizes with f32 broadcasts then casts; scale-shift rounds
+        # a/b to bf16 first) — bound it, don't equate it.
+        np.testing.assert_allclose(
+            np.asarray(y1, np.float32),
+            np.asarray(y2, np.float32),
+            atol=0.04,
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-3
+            ),
+            s1,
+            s2,
+        )
+
+    def test_gradients_match_flax_f32(self):
+        x = jax.random.normal(jax.random.key(4), (8, 4, 4, 8))
+
+        def loss(cls, v, x):
+            m = cls(use_running_average=False, dtype=jnp.float32)
+            y, _ = m.apply(v, x, mutable=["batch_stats"])
+            return jnp.sum(jnp.square(y))
+
+        v = nn.BatchNorm(use_running_average=False).init(jax.random.key(0), x)
+        g1 = jax.grad(lambda xx: loss(nn.BatchNorm, v, xx))(x)
+        g2 = jax.grad(lambda xx: loss(ScaleShiftBatchNorm, v, xx))(x)
+        np.testing.assert_allclose(
+            np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-5
+        )
+
+    def test_cross_replica_stats_psum(self, world8):
+        """axis_name syncs the sufficient statistics: per-device outputs
+        must equal single-device BN over the concatenated batch."""
+        from jax.sharding import PartitionSpec as P
+
+        x = jax.random.normal(jax.random.key(5), (16, 4, 4, 8))
+        m_global = ScaleShiftBatchNorm()
+        v = m_global.init(jax.random.key(0), x)
+        y_ref, mut_ref = m_global.apply(v, x, mutable=["batch_stats"])
+
+        m_sync = ScaleShiftBatchNorm(axis_name="data")
+
+        def f(xs):
+            y, mut = m_sync.apply(v, xs, mutable=["batch_stats"])
+            return y, mut["batch_stats"]
+
+        y, stats = world8.shard_map(
+            f, in_specs=P("data"), out_specs=(P("data"), P(None))
+        )(x)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(y_ref), rtol=1e-4, atol=1e-5
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            stats,
+            mut_ref["batch_stats"],
+        )
+
+    def test_resnet_swap_is_numerically_consistent(self):
+        """ResNet-50 forward with the scale-shift BN vs the flax oracle,
+        f32 end to end: same logits up to reduction noise."""
+        x = jax.random.normal(jax.random.key(6), (2, 64, 64, 3))
+        kw = dict(
+            num_classes=10, dtype=jnp.float32, norm_dtype=jnp.float32,
+            stage_sizes=(1, 1),
+        )
+        ref = ResNet50(norm=nn.BatchNorm, **kw)
+        new = ResNet50(**kw)
+        v_ref = jax.jit(ref.init)(jax.random.key(0), x)
+
+        # Identical param/stat layout up to module NAMES (BatchNorm_i ↔
+        # ScaleShiftBatchNorm_i): the oracle's variables, key-renamed,
+        # must load straight into the scale-shift model.
+        def rename(tree):
+            if isinstance(tree, dict):
+                return {
+                    k.replace("BatchNorm", "ScaleShiftBatchNorm"): rename(v)
+                    for k, v in tree.items()
+                }
+            return tree
+
+        v_new = rename(v_ref)
+        y_ref, _ = ref.apply(v_ref, x, mutable=["batch_stats"])
+        y_new, _ = new.apply(v_new, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(
+            np.asarray(y_ref), np.asarray(y_new), rtol=1e-3, atol=1e-3
+        )

@@ -70,6 +70,7 @@ class TestRingAttention:
         for a, b in zip(g_ref, g_ring):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
+    @pytest.mark.slow
     def test_inside_gpt2(self):
         # ring attention as GPT2's attention_fn, seq axis over 4 devices
         world = comm.init({"seq": 4}, set_default=False, devices=jax.devices()[:4])
@@ -244,6 +245,7 @@ class TestPjitTP:
         assert losses[-1] < losses[0]
         assert int(jax.device_get(state.step)) == 5
 
+    @pytest.mark.slow
     def test_tp_matches_single_device_trajectory(self):
         import optax
 
@@ -421,6 +423,81 @@ class TestMoE:
         norms = np.linalg.norm(np.asarray(out), axis=-1)
         assert (norms < 1e-6).any()
 
+    @pytest.mark.parametrize("cf", [0.25, 1.0, 16.0])
+    def test_sort_dispatch_matches_einsum_oracle(self, cf):
+        """The ragged (argsort/scatter) backend against the one-hot
+        oracle: same routing, same queue order, same drops — outputs,
+        stats, AND gradients (round-4 verdict item 3). Swept across
+        heavy-drop, realistic, and no-drop capacity regimes."""
+        params = self._params(jax.random.key(21))
+        x = jax.random.normal(jax.random.key(22), (4, 16, 8))
+
+        o1, a1, s1 = expert_parallel_moe(
+            x, params, k=2, capacity_factor=cf, with_stats=True,
+            dispatch="einsum",
+        )
+        o2, a2, s2 = expert_parallel_moe(
+            x, params, k=2, capacity_factor=cf, with_stats=True,
+            dispatch="sort",
+        )
+        np.testing.assert_allclose(
+            np.asarray(o1), np.asarray(o2), rtol=2e-5, atol=2e-6
+        )
+        np.testing.assert_allclose(float(a1), float(a2), rtol=1e-6)
+        np.testing.assert_allclose(
+            float(s1["drop_rate"]), float(s2["drop_rate"]), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(s1["expert_load"]), np.asarray(s2["expert_load"])
+        )
+
+        def loss(p, backend):
+            o, a = expert_parallel_moe(
+                x, p, k=2, capacity_factor=cf, dispatch=backend
+            )
+            return jnp.sum(o**2) + a
+
+        g1 = jax.grad(loss)(params, "einsum")
+        g2 = jax.grad(loss)(params, "sort")
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5
+            ),
+            g1,
+            g2,
+        )
+
+    def test_sort_dispatch_expert_parallel_matches_dense(self):
+        """The EP all-to-all path with the sort backend (the slot tensor
+        layout is backend-independent, so the collective must compose
+        identically)."""
+        world = comm.init({"expert": 8}, set_default=False)
+        params = self._params(jax.random.key(23))
+        x = jax.random.normal(jax.random.key(24), (32, 8))
+
+        dense_out, _ = expert_parallel_moe(
+            x, params, k=2, capacity_factor=16.0, dispatch="sort"
+        )
+        ep_specs = {
+            "router": P(),
+            "w_in": P("expert"),
+            "b_in": P("expert"),
+            "w_out": P("expert"),
+            "b_out": P("expert"),
+        }
+        f = world.shard_map(
+            lambda x, p: expert_parallel_moe(
+                x, p, k=2, capacity_factor=16.0, axis="expert",
+                dispatch="sort",
+            ),
+            in_specs=(P("expert"), ep_specs),
+            out_specs=(P("expert"), P()),
+        )
+        ep_out, _ = f(x, params)
+        np.testing.assert_allclose(
+            np.asarray(ep_out), np.asarray(dense_out), atol=1e-5
+        )
+
 
 class TestRingFlashAttention:
     """CP ring with the fused Pallas block kernel (interpret on CPU mesh)."""
@@ -451,6 +528,7 @@ class TestRingFlashAttention:
             np.asarray(f(q, k, v)), np.asarray(full), rtol=3e-5, atol=3e-5
         )
 
+    @pytest.mark.slow
     def test_gradients_match_full_attention(self, n_devices):
         import mpit_tpu
         from mpit_tpu.ops import reference_attention
@@ -481,6 +559,7 @@ class TestRingFlashAttention:
             )
 
 
+@pytest.mark.slow
 class TestContextParallelTraining:
     """The CP train step (parallel.cp): sequence-sharded GPT-2."""
 
@@ -570,6 +649,7 @@ class TestHeadDtype:
         np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
 
 
+@pytest.mark.slow
 class TestPipelineParallelTraining:
     """The PP train step (parallel.pp): stage-sharded GPT-2 + GPipe ring."""
 
@@ -862,6 +942,7 @@ class Test1F1BSchedule:
         assert tg_[1] > tg_[0] * 3, (t1, tg_)
 
 
+@pytest.mark.slow
 class TestInterleaved1F1B:
     """spmd_pipeline_interleaved_1f1b (round 3): virtual stages — V
     chunks per device, activations circle the ring V times."""
@@ -1023,6 +1104,7 @@ class TestInterleaved1F1B:
         assert t[1] <= t[0] * 1.1 + 4096, t
 
 
+@pytest.mark.slow
 class TestPerLeafGradientParity:
     """VERDICT round-1 item 8: the tiers' effective gradients checked
     leaf-by-leaf against single-device autodiff (one optimizer step with
@@ -1626,6 +1708,7 @@ class TestExpertParallelTier:
         assert out["final_loss"] < out["uniform_loss"]
 
 
+@pytest.mark.slow
 class TestTierCheckpointing:
     """--ckpt-dir on the hand-driven tiers (round 2): restore against the
     tier's own state_specs + deterministic stream fast-forward."""

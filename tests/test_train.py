@@ -261,3 +261,109 @@ class TestCheckpoint:
             # Pinning the horizon to the original decay length: accepted.
             cfg3 = dataclasses.replace(cfg, steps=80, schedule_horizon=100)
             m.ensure_meta(run_meta(cfg3))
+
+    def test_meta_merge_warns_on_nondefault_new_field(self, world8, tmp_path):
+        """Merging a geometry field the recorded meta predates: silent at
+        the default value (the original run implicitly ran it), warned at
+        a non-default value (drift against the original run cannot be
+        validated — round-4 advisor finding)."""
+        import dataclasses
+        import json as _json
+        import warnings
+
+        from mpit_tpu.asyncsgd.config import TrainConfig
+        from mpit_tpu.asyncsgd.runner import run_meta
+        from mpit_tpu.train import CheckpointManager
+
+        cfg = TrainConfig()
+        defaults = run_meta(TrainConfig())
+        ckdir = tmp_path / "ck"
+        with CheckpointManager(ckdir, world8, async_save=False) as m:
+            m.ensure_meta(run_meta(cfg), defaults=defaults)
+            m.save(1, {"x": jnp.zeros(8)})
+            m.wait()
+        # Simulate a pre-``train_size`` checkpoint directory.
+        meta_path = ckdir / "run_meta.json"
+        recorded = _json.loads(meta_path.read_text())
+        del recorded["train_size"]
+        meta_path.write_text(_json.dumps(recorded))
+
+        with CheckpointManager(ckdir, world8, async_save=False) as m:
+            # Default value for the new field: benign, no warning.
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")
+                m.ensure_meta(run_meta(cfg), defaults=defaults)
+        # The merge recorded it; strip again to test the non-default path.
+        recorded = _json.loads(meta_path.read_text())
+        del recorded["train_size"]
+        meta_path.write_text(_json.dumps(recorded))
+        with CheckpointManager(ckdir, world8, async_save=False) as m:
+            cfg16 = dataclasses.replace(cfg, train_size=16)
+            with pytest.warns(UserWarning, match="train_size"):
+                m.ensure_meta(run_meta(cfg16), defaults=defaults)
+        # And now it IS recorded (=16), so a later default run drifts.
+        with CheckpointManager(ckdir, world8, async_save=False) as m:
+            with pytest.raises(ValueError, match="train_size"):
+                m.ensure_meta(run_meta(cfg), defaults=defaults)
+
+    def test_run_meta_stream_impl_resolution(self, monkeypatch, tmp_path):
+        """stream_impl must pin ``native_core`` whenever the C++ core will
+        draw RNG: the synthetic native stream AND a file dataset whose rrc
+        augmentation routes through mpit_rrc_batch (round-4 advisor: the
+        file+rrc case recorded ``python`` while drawing from the C++
+        stream, so resume on a core-less host silently changed the
+        augmentation stream)."""
+        import dataclasses
+        import json as _json
+
+        from mpit_tpu.asyncsgd.config import TrainConfig
+        from mpit_tpu.asyncsgd.runner import run_meta
+        from mpit_tpu.data import native as native_mod
+
+        cls_dir = tmp_path / "cls"
+        cls_dir.mkdir()
+        (cls_dir / "meta.json").write_text(
+            _json.dumps({"kind": "classification", "num_classes": 4})
+        )
+        lm_dir = tmp_path / "lm"
+        lm_dir.mkdir()
+        (lm_dir / "meta.json").write_text(
+            _json.dumps({"kind": "lm", "vocab_size": 64})
+        )
+
+        base = TrainConfig(native=True)
+        file_rrc = dataclasses.replace(
+            base, data_dir=str(cls_dir), augment=True, augment_mode="rrc"
+        )
+        file_shift = dataclasses.replace(
+            base, data_dir=str(cls_dir), augment=True, augment_mode="shift"
+        )
+        lm_rrc = dataclasses.replace(
+            base, data_dir=str(lm_dir), augment=True, augment_mode="rrc"
+        )
+
+        monkeypatch.setattr(native_mod, "available", lambda: True)
+        assert run_meta(base)["stream_impl"] == "native_core"  # synthetic
+        assert run_meta(file_rrc)["stream_impl"] == "native_core"
+        # File gather + shift augmentation never touch the core.
+        assert run_meta(file_shift)["stream_impl"] == "python"
+        # An LM dataset never routes augmentation through the core, no
+        # matter what stray flags say (round-4 review on the r5 fix).
+        assert run_meta(lm_rrc)["stream_impl"] == "python"
+
+        monkeypatch.setattr(native_mod, "available", lambda: False)
+        assert run_meta(base)["stream_impl"] == "python"
+        assert run_meta(file_rrc)["stream_impl"] == "python"
+
+    def test_save_dense_rejected_multiprocess(self, monkeypatch):
+        """--save-dense on a multi-process run must fail at config time,
+        not after training completes (round-4 advisor: dense_from_dp's
+        single-controller check fired only at end of run)."""
+        from mpit_tpu.asyncsgd import mnist
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        with pytest.raises(SystemExit, match="single controller"):
+            mnist.main(
+                ["--steps", "2", "--batch-size", "8",
+                 "--save-dense", "/tmp/never-written.npz"]
+            )
