@@ -362,6 +362,10 @@ def bench_gpt2(calls: int = 3, scan_steps: int = 8, warmup: int = 1, seq: int = 
     tok/s), so it is the default on TPU from T=512 up. Round 4
     (trace-driven, BENCHMARKS.md): head-packed flash layout (no q/k/v
     transposes) + unrolled LM-head vocab loops → 127.0–130.3k tok/s.
+    Round 5: batch re-sweep — 48→132.5k @56 / 132.2k @64 (plateau),
+    119.4k @80 (HBM pressure), compile-OOM @96; 56 is the new default
+    (50.0% MFU; the remaining gap is the documented D=64/LM-head bound,
+    BENCHMARKS.md §GPT-2 ceiling).
     """
     import mpit_tpu
     from jax.sharding import PartitionSpec as P
@@ -372,7 +376,7 @@ def bench_gpt2(calls: int = 3, scan_steps: int = 8, warmup: int = 1, seq: int = 
 
     world = mpit_tpu.init()
     n = world.num_devices
-    batch = 48 * n
+    batch = 56 * n
     on_tpu = jax.devices()[0].platform == "tpu"
 
     kw = dict(max_seq_len=seq, head_dtype=jnp.bfloat16)
@@ -688,7 +692,11 @@ class _Emitter:
         rec["detail"]["devices"] = self.devices
         rec["detail"]["platform"] = self.platform
         try:
-            with open(os.path.join(_REPO, "BENCH_DETAIL.json"), "w") as f:
+            # tmp + atomic rename (same pattern as train/checkpoint.py's
+            # run_meta): a watchdog os._exit mid-dump must never leave a
+            # half-written file where the record line points.
+            path = os.path.join(_REPO, "BENCH_DETAIL.json")
+            with open(path + ".tmp", "w") as f:
                 json.dump(
                     {
                         "elapsed_s": round(elapsed, 1),
@@ -701,6 +709,7 @@ class _Emitter:
                     f,
                     indent=1,
                 )
+            os.replace(path + ".tmp", path)
         except OSError as e:
             rec["detail_file_error"] = str(e)[:80]
         line = json.dumps(rec)
@@ -732,12 +741,17 @@ def main():
         # but then nothing in-process could run; progressive emission
         # (the already-printed lines in the driver's tail) is the
         # backstop for that case.
-        remaining = [n for n, _ in workloads if n not in em.results]
-        em.truncated.extend(
-            n for n in remaining if n not in em.truncated
-        )
-        em.emit()
-        os._exit(0)
+        try:
+            remaining = [n for n, _ in workloads if n not in em.results]
+            em.truncated.extend(
+                n for n in remaining if n not in em.truncated
+            )
+            em.emit()
+        finally:
+            # Exit unconditionally: an emit() error here (e.g. a dict
+            # mutated concurrently by the main thread) must not leave
+            # the process alive past the driver's timeout.
+            os._exit(0)
 
     import threading
 

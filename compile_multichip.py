@@ -39,9 +39,17 @@ Phases (mirroring the dryrun, plus the memory-regression shape):
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 import traceback
+
+# Persistent compile cache (same dir as bench.py): repeated AOT runs —
+# and the driver's — replay cached compilations instead of paying the
+# multi-minute phases again.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
 
 import jax
 import jax.numpy as jnp
@@ -233,7 +241,8 @@ def phase_pp_interleaved(topology):
     specs = state_specs(split)
     state = abstractify(jax.eval_shape(init_fn, split), world.mesh, specs)
     batch_abs = abstractify(
-        {"tokens": jax.ShapeDtypeStruct((8, seq + 1), jnp.int32)},
+        # 32 rows / data=4 → 8 per device = 2 rows × 4 microbatches.
+        {"tokens": jax.ShapeDtypeStruct((32, seq + 1), jnp.int32)},
         world.mesh,
         P("data"),
     )

@@ -30,6 +30,13 @@ class ResnetConfig(TrainConfig):
     num_classes: int = 1000
     lr: float = 0.1
     weight_decay: float = 1e-4
+    # BN implementation: "scale_shift" (models/norm.py, the round-5
+    # default) or "flax" (nn.BatchNorm). The two are numerically
+    # parity-tested but name their modules differently, so the
+    # checkpoint tree differs — as a workload-config field this is
+    # pinned by run_meta (ensure_meta), and pre-round-5 checkpoint
+    # directories resume with --bn-impl flax.
+    bn_impl: str = "scale_shift"
 
 
 def main(argv: list[str] | None = None, **overrides) -> dict:
@@ -52,7 +59,14 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             num_classes=dataset.num_classes,
             image_size=dataset.image_shape[0],
         )
-    model = ResNet50(num_classes=cfg.num_classes)
+    if cfg.bn_impl not in ("scale_shift", "flax"):
+        raise SystemExit(f"--bn-impl must be scale_shift or flax, got {cfg.bn_impl!r}")
+    if cfg.bn_impl == "flax":
+        import flax.linen as nn
+
+        model = ResNet50(num_classes=cfg.num_classes, norm=nn.BatchNorm)
+    else:
+        model = ResNet50(num_classes=cfg.num_classes)
 
     def init_params():
         variables = model.init(

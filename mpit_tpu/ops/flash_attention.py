@@ -487,6 +487,19 @@ def _group_resident(t, g, d, block_q, block_k, itemsize):
     return full_pair + max(fwd_tiles, dq_tiles, dkv_tiles) + score
 
 
+def usable_head_groups(h: int, d: int) -> list:
+    """Proper divisors of H usable as head groups, largest first: the
+    group's lane width G·D must be a 128-multiple (the block is a lane
+    slice ``[1, rows, G·D]`` of the packed array). Shared by the chooser
+    below and the sweep validator (``sweep_flash_vmem.py``) so the two
+    cannot drift."""
+    return [
+        g
+        for g in range(h - 1, 0, -1)
+        if h % g == 0 and (g * d) % _LANES == 0
+    ]
+
+
 def _pick_head_group(t, h, d, block_q, block_k, itemsize, interpret=False):
     """Heads processed per kernel program. All-heads packing is fastest
     (fewest programs, no relayouts) but its resident set grows with T;
@@ -505,11 +518,7 @@ def _pick_head_group(t, h, d, block_q, block_k, itemsize, interpret=False):
     # Usable groups: proper divisors of H whose lane width is a multiple
     # of 128 (G = H itself is legal regardless — full-dim minor block —
     # but it just failed the budget above).
-    candidates = [
-        g
-        for g in range(h - 1, 0, -1)
-        if h % g == 0 and (g * d) % _LANES == 0
-    ]
+    candidates = usable_head_groups(h, d)
     for g in candidates:
         if _group_resident(t, g, d, block_q, block_k, itemsize) <= _VMEM_BUDGET:
             return g

@@ -16,6 +16,7 @@ the DP path (round-2 verdict item 4).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Iterator
 
 import jax
@@ -97,9 +98,10 @@ def hardened_loop(
             "callable returning the state's PartitionSpecs"
         )
     logger = logger or MetricLogger()
-    meter = Throughput()
     start_step = int(state.step)
     items = items_per_batch
+    log_t: float | None = None  # wall clock at the last forced log fetch
+    log_step = start_step
 
     prof_window = None
     if profile_dir and steps > start_step:
@@ -176,7 +178,6 @@ def hardened_loop(
                     jax.profiler.stop_trace()
                     tracing = False
                     trace_done = True
-                rate = meter.tick(items) if items else None
                 should_log = (step + 1) % log_every == 0 or step + 1 == steps
                 should_save = bool(
                     ckpt and ckpt_every and (step + 1) % ckpt_every == 0
@@ -226,9 +227,20 @@ def hardened_loop(
                     if should_log:
                         loss_trace.append((step + 1, loss))
                         out = {k: float(v) for k, v in metrics.items()}
-                        if rate is not None:
-                            out["items_per_sec"] = rate
+                        # Interval throughput, measured BETWEEN forced
+                        # host fetches: the float(loss) above drained the
+                        # async dispatch queue, so the interval's wall
+                        # clock covers real device execution. (A per-step
+                        # tick would time the host DISPATCH of steps the
+                        # device hasn't run yet — the round-5 rehearsal
+                        # measured 52k "img/s" that way.) First interval
+                        # (compilation) excluded by construction.
+                        now = time.perf_counter()
+                        if items and log_t is not None:
+                            rate = items * (step + 1 - log_step) / (now - log_t)
+                            out["items_per_sec"] = round(rate, 2)
                             rate_trace.append(rate)
+                        log_t, log_step = now, step + 1
                         logger.log(step + 1, out)
                     if should_save:
                         ckpt.save(step + 1, state)

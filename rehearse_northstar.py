@@ -119,13 +119,32 @@ def main(workdir: str | None = None) -> int:
     record: dict = {"workdir": work}
 
     # -- stage 1+2: JPEG tree → streaming import ---------------------------
+    # The import runs in a CPU-pinned subprocess: this parent must stay
+    # JAX-free so the axon chip is exclusively the training children's
+    # (two processes cannot share this environment's tunneled backend).
     t0 = time.perf_counter()
     if not os.path.exists(os.path.join(ds_dir, "meta.json")):
         make_jpeg_tree(src)
         sys.path.insert(0, REPO)
-        from mpit_tpu.data import import_image_directory
+        import reexec_cpu
 
-        import_image_directory(src, ds_dir, size=STORE)
+        imp = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "from mpit_tpu.data import import_image_directory; "
+                f"import_image_directory({src!r}, {ds_dir!r}, size={STORE})",
+            ],
+            env=reexec_cpu.cpu_mesh_env(1),
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        if imp.returncode != 0:
+            print(imp.stdout[-2000:] + imp.stderr[-2000:])
+            print("rehearsal: FAIL — import stage exited nonzero")
+            return 1
     record["import_s"] = round(time.perf_counter() - t0, 1)
     print(f"rehearsal: imported {CLASSES}x{PER_CLASS} JPEGs -> {ds_dir} "
           f"({record['import_s']}s)")

@@ -104,18 +104,16 @@ def main(topology: str = "v5e:2x4") -> int:
                 # estimator says it overflows — make the compiler agree.
                 reject = None
                 if g is not None and g < h:
-                    larger = [
-                        c
-                        for c in range(h, g, -1)
-                        if h % c == 0 and (c * d) % fa._LANES == 0
+                    # The candidate one step larger than the choice: G=H
+                    # (always usable as the full-dim block) or the next
+                    # usable divisor above g — same predicate as the
+                    # chooser (fa.usable_head_groups, shared).
+                    larger = [h] + [
+                        c for c in fa.usable_head_groups(h, d) if c > g
                     ]
-                    reject = larger[-1] if larger else None
+                    reject = larger[-1]
                 elif g is None:
-                    usable = [
-                        c
-                        for c in range(h - 1, 0, -1)
-                        if h % c == 0 and (c * d) % fa._LANES == 0
-                    ]
+                    usable = fa.usable_head_groups(h, d)
                     reject = usable[-1] if usable else None
                 if reject is not None:
                     try:
