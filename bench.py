@@ -60,13 +60,22 @@ from __future__ import annotations
 import functools
 import json
 import os
+import threading
 import time
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 
 def _enable_compile_cache() -> None:
-    """Persistent XLA compile cache — MUST run before the first trace."""
+    """Persistent XLA compile cache — MUST run before the first trace.
+
+    Called from :func:`main`, NOT at import: tests import this module
+    for the record builder, and enabling a process-global cache as an
+    import side effect poisoned the whole test process (cache entries
+    written by a different jaxlib/backend deserialize into executables
+    the host backend crashes on — observed as a segfault in the first
+    jitted train step of any test that ran after an `import bench`).
+    """
     import jax
 
     jax.config.update(
@@ -76,8 +85,6 @@ def _enable_compile_cache() -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 
-_enable_compile_cache()
-
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
@@ -86,12 +93,15 @@ def _timed_steps(step_fn, state, batches, n):
     """Run n chunk-calls alternating pre-staged (stacked) batches; returns
     (dt, loss, state). The window closes on a host-value fetch (see module
     docstring)."""
-    t0 = time.perf_counter()
-    metrics = {}
-    for i in range(n):
-        state, metrics = step_fn(state, batches[i % 2])
-    loss = float(metrics["loss"])  # forces completion of the whole chain
-    return time.perf_counter() - t0, loss, state
+    from mpit_tpu import obs
+
+    with obs.span("timed_window", calls=n):
+        t0 = time.perf_counter()
+        metrics = {}
+        for i in range(n):
+            state, metrics = step_fn(state, batches[i % 2])
+        loss = float(metrics["loss"])  # forces completion of the whole chain
+        return time.perf_counter() - t0, loss, state
 
 
 def _best_window(step_fn, state, batches, steps, repeats=3):
@@ -110,7 +120,10 @@ def _measure(step_fn, state, batches, *, calls, scan_steps, warmup):
     """The shared timed-run scaffold (warmup, then best-of-N windows):
     every bench measures through this one path so the methodology cannot
     drift between workloads. Returns ``(dt, steps, final_loss, state)``."""
-    _, _, state = _timed_steps(step_fn, state, batches, warmup)
+    from mpit_tpu import obs
+
+    with obs.span("warmup", calls=warmup):
+        _, _, state = _timed_steps(step_fn, state, batches, warmup)
     dt, final_loss, state = _best_window(step_fn, state, batches, calls)
     return dt, calls * scan_steps, final_loss, state
 
@@ -119,11 +132,13 @@ def _stack_batches(world, stream, k: int, spec=None):
     """Stage k distinct batches on device as one [k, ...]-stacked chunk."""
     import numpy as np
 
+    from mpit_tpu import obs
     from mpit_tpu.data import shard_batch
 
-    host = [next(stream) for _ in range(k)]
-    stacked = jax.tree.map(lambda *xs: np.stack(xs), *host)
-    return shard_batch(world, stacked, spec=spec)
+    with obs.span("staging", batches=k):
+        host = [next(stream) for _ in range(k)]
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *host)
+        return shard_batch(world, stacked, spec=spec)
 
 
 def _device_image_batches(
@@ -143,6 +158,8 @@ def _device_image_batches(
     """
     from jax.sharding import NamedSharding
 
+    from mpit_tpu import obs
+
     lead = () if k is None else (k,)
     out_shardings = {
         "image": NamedSharding(world.mesh, spec),
@@ -161,7 +178,8 @@ def _device_image_batches(
             ),
         }
 
-    return gen(jax.random.key(seed))
+    with obs.span("staging", on_device=True):
+        return gen(jax.random.key(seed))
 
 
 def bench_alexnet(
@@ -601,6 +619,24 @@ def _round1_baselines():
     return alex, gpt2
 
 
+def _phase_breakdown(rec) -> dict:
+    """Per-workload obs roll-up for BENCH_DETAIL.json (never the record
+    line — ``_LINE_KEYS`` whitelists what rides there): where the
+    workload's wall clock went, plus the top collectives by modeled
+    wire bytes from the trace-time accounting in comm/collectives."""
+    s = rec.summary(top_collectives=3)
+    out = {
+        name: {"count": p["count"], "total_s": round(p["total_s"], 3)}
+        for name, p in s["phases"].items()
+    }
+    if s["collectives"]:
+        out["top_collectives"] = [
+            {**c, "wire_bytes": round(c["wire_bytes"], 1)}
+            for c in s["collectives"]
+        ]
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Driver-contract record building (unit-tested: tests/test_bench_contract.py)
 # ---------------------------------------------------------------------------
@@ -682,8 +718,29 @@ class _Emitter:
         self.truncated: list = []
         self.platform = jax.devices()[0].platform
         self.devices = jax.device_count()
+        # emit() runs on BOTH the main thread (per-workload) and the
+        # watchdog timer thread (timeout path); without mutual exclusion
+        # the two interleave the BENCH_DETAIL.json rename with the final
+        # record print (round-5 advisor finding). One lock serializes
+        # whole emissions; the last writer's line is last in the tail.
+        self._lock = threading.Lock()
 
-    def emit(self, pending=()):
+    def emit(self, pending=(), lock_timeout=None):
+        """``lock_timeout`` (watchdog path): best-effort acquire so a
+        main thread wedged INSIDE _emit_locked (stalled stdout pipe,
+        hung filesystem) cannot keep the watchdog from its os._exit —
+        the wedged emitter's already-printed line is the record then."""
+        if lock_timeout is None:
+            with self._lock:
+                return self._emit_locked(pending)
+        if self._lock.acquire(timeout=lock_timeout):
+            try:
+                return self._emit_locked(pending)
+            finally:
+                self._lock.release()
+        return None
+
+    def _emit_locked(self, pending=()):
         elapsed = time.perf_counter() - self.t0
         rec = build_record(
             self.results, pending=pending, truncated=self.truncated,
@@ -718,6 +775,7 @@ class _Emitter:
 
 
 def main():
+    _enable_compile_cache()  # before the first trace (see its docstring)
     t0 = time.perf_counter()
     budget = float(os.environ.get("MPIT_BENCH_BUDGET_S", "420"))
     em = _Emitter(t0)
@@ -746,18 +804,18 @@ def main():
             em.truncated.extend(
                 n for n in remaining if n not in em.truncated
             )
-            em.emit()
+            em.emit(lock_timeout=15.0)
         finally:
             # Exit unconditionally: an emit() error here (e.g. a dict
             # mutated concurrently by the main thread) must not leave
             # the process alive past the driver's timeout.
             os._exit(0)
 
-    import threading
-
     watchdog = threading.Timer(budget * 1.2 + 30, _watchdog)
     watchdog.daemon = True
     watchdog.start()
+
+    from mpit_tpu import obs
 
     for i, (name, fn) in enumerate(workloads):
         elapsed = time.perf_counter() - t0
@@ -765,8 +823,13 @@ def main():
             em.truncated.extend(n for n, _ in workloads[i:])
             break
         t_w = time.perf_counter()
+        # Fresh recorder per workload: the phase breakdown attached to
+        # BENCH_DETAIL.json covers exactly this workload's events
+        # (staging/warmup/timed windows + trace-time collective bytes).
+        rec = obs.enable(obs.Recorder())
         try:
-            em.results[name] = fn()
+            with obs.span("workload", workload=name):
+                em.results[name] = fn()
         except Exception as e:  # one workload must not kill the artifact
             em.results[name] = {
                 "error": f"{type(e).__name__}: {e}"[:200]
@@ -774,8 +837,10 @@ def main():
         # Wall seconds the workload took end to end (compile + staging +
         # measurement) — the time-budget diagnostic; detail-file only.
         em.results[name]["wall_s"] = round(time.perf_counter() - t_w, 1)
+        em.results[name]["phases"] = _phase_breakdown(rec)
         em.emit(pending=[n for n, _ in workloads[i + 1:]])
 
+    obs.disable()
     watchdog.cancel()
     em.emit()
 

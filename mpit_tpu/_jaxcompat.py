@@ -1,0 +1,170 @@
+"""Forward-compat gate: run the jax-0.9-targeted codebase on older jax.
+
+This framework is written against jax 0.9's API surface (``jax.typeof``
+with VMA-typed avals, ``lax.axis_size``, top-level ``jax.shard_map`` with
+``check_vma``, ``jax.sharding.AxisType``, varying→invariant
+``all_gather_invariant``). Some environments (this build container: jax
+0.4.37) predate all of those. Per the repo rule "stub or gate missing
+deps", this module installs *semantics-preserving* fallbacks onto the
+``jax``/``lax`` namespaces at import time, so the hundreds of call sites
+keep reading as the 0.9 code they are:
+
+- ``lax.axis_size(name)`` → ``lax.psum(1, name)``, which constant-folds
+  to a concrete int under tracing on every jax since 0.2.
+- ``jax.typeof(x)`` → ``jax.core.get_aval(x)``. Call sites only ever do
+  ``getattr(jax.typeof(x), "vma", ...)``; pre-VMA avals simply have no
+  ``vma`` attribute and the fallback branch is taken — correct, because
+  pre-0.9 shard_map has no varying/replicated type system to satisfy.
+- ``jax.shard_map(..., check_vma=...)`` →
+  ``jax.experimental.shard_map.shard_map(..., check_rep=False)``. The
+  VMA checker does not exist pre-0.9; its closest ancestor
+  (``check_rep``) enforces *different* (stricter, psum-inserting)
+  replication rules that the VMA-era code deliberately opts out of via
+  ``vary()`` — so the honest mapping is "off". Gradient semantics are
+  unchanged: grads of replicated params stay device-local and the train
+  step owns its one reduction, exactly what ``collectives.vary``
+  arranges under 0.9 (see its docstring).
+- ``vary()``'s ``pvary`` retype and the invariant all-gather degrade to
+  identity / plain ``lax.all_gather`` — they are *type-system* markers;
+  the runtime data movement is identical.
+
+Nothing is patched when running under a jax that already provides the
+real API (``hasattr`` gates everywhere), so on 0.9 this module is inert.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+# True when this jax has the VMA (varying/replicated) type system — the
+# 0.9-era API this codebase targets natively. Cross-tier gradient parity
+# (the 3-D and EP tiers' single-device-exactness) depends on VMA AD
+# semantics; tests for it skip on pre-VMA jax.
+HAS_VMA = hasattr(jax, "typeof")
+
+# True when pallas ships the TPU interpret mode (pltpu.InterpretParams) —
+# the multi-"device" remote-DMA/semaphore simulator the ring-allreduce
+# kernel's CPU tests require. The pre-0.9 generic pallas interpreter
+# cannot simulate cross-device DMA, so those tests skip without this.
+try:
+    from jax.experimental.pallas import tpu as _pltpu_probe
+
+    HAS_TPU_INTERPRET = hasattr(_pltpu_probe, "InterpretParams")
+except ImportError:  # pallas TPU backend absent entirely
+    HAS_TPU_INTERPRET = False
+
+
+def _axis_size(name) -> int:
+    # psum of a Python scalar constant-folds to the concrete axis size.
+    return lax.psum(1, name)
+
+
+def _typeof(x):
+    return jax.core.get_aval(x)
+
+
+def all_gather_invariant(x, axis_name, *, axis: int = 0, tiled: bool = False):
+    """Pre-0.9 stand-in: plain all_gather (the result IS identical on
+    every device; only the 0.9 VMA *typing* of that fact is missing)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def pvary(x, names):
+    """Pre-0.9 stand-in for the replicated→varying retype: identity.
+    Without a VMA checker there is nothing to retype for."""
+    del names
+    return x
+
+
+def _shard_map(f=None, *, mesh=None, in_specs=None, out_specs=None,
+               check_vma: bool = True):
+    from jax.experimental.shard_map import shard_map as _sm
+
+    del check_vma  # no VMA checker to configure pre-0.9 (docstring above)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
+def install() -> None:
+    """Install the fallbacks onto jax/lax where the real API is absent."""
+    if not hasattr(lax, "axis_size"):
+        lax.axis_size = _axis_size
+    if not hasattr(jax, "typeof"):
+        jax.typeof = _typeof
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map
+
+    import inspect
+
+    try:
+        params = inspect.signature(jax.ShapeDtypeStruct.__init__).parameters
+        accepts_vma = "vma" in params
+    except (TypeError, ValueError):  # C-implemented signature: assume new
+        accepts_vma = True
+    if not accepts_vma:
+        _Real = jax.ShapeDtypeStruct
+
+        class _VmaShapeDtypeStruct(_Real):
+            """0.9's ``ShapeDtypeStruct(..., vma=...)`` on pre-VMA jax:
+            the vma annotation (how a Pallas out_shape varies across
+            mesh axes) has no pre-0.9 counterpart — drop it. Subclass,
+            not factory, so ``isinstance(x, jax.ShapeDtypeStruct)``
+            keeps working for instances made through the public name."""
+
+            def __init__(self, shape, dtype, *, vma=None, **kw):
+                del vma
+                super().__init__(shape, dtype, **kw)
+
+        jax.ShapeDtypeStruct = _VmaShapeDtypeStruct
+
+    # Pallas-TPU interpret params: 0.9 spells interpret mode as
+    # ``interpret=pltpu.InterpretParams(...)``; old pallas takes a bool.
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "InterpretParams"):
+            def _interpret_params(**kw):
+                del kw
+                return True
+
+            pltpu.InterpretParams = _interpret_params
+        if not hasattr(pltpu, "CompilerParams") and hasattr(
+            pltpu, "TPUCompilerParams"
+        ):
+            def _compiler_params(**kw):
+                allowed = set(
+                    inspect.signature(pltpu.TPUCompilerParams).parameters
+                )
+                return pltpu.TPUCompilerParams(
+                    **{k: v for k, v in kw.items() if k in allowed}
+                )
+
+            pltpu.CompilerParams = _compiler_params
+    except ImportError:
+        pass
+
+
+def make_mesh(axis_sizes, axis_names):
+    """``jax.make_mesh`` with AxisType.Auto where the type exists (0.9:
+    the default of Explicit leaks sharding-in-types avals into host-level
+    ops), and without the argument where it doesn't (pre-0.9 meshes have
+    no axis types — every axis already behaves like Auto)."""
+    if hasattr(jax.sharding, "AxisType"):
+        axis_types = (jax.sharding.AxisType.Auto,) * len(axis_names)
+        return jax.make_mesh(tuple(axis_sizes), tuple(axis_names), axis_types)
+    return jax.make_mesh(tuple(axis_sizes), tuple(axis_names))
+
+
+def mesh_from_devices(dev_array, axis_names):
+    """``jax.sharding.Mesh`` from an explicit device array, axis-typed
+    Auto on 0.9 (same rationale as :func:`make_mesh`)."""
+    from jax.sharding import Mesh
+
+    if hasattr(jax.sharding, "AxisType"):
+        axis_types = (jax.sharding.AxisType.Auto,) * len(axis_names)
+        return Mesh(dev_array, tuple(axis_names), axis_types=axis_types)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+install()

@@ -34,6 +34,7 @@ import numpy as np
 import optax
 
 from mpit_tpu import compat as mpiT
+from mpit_tpu.obs import core as _obs
 
 TAG_FETCH = 11
 TAG_PARAM = 12
@@ -42,6 +43,11 @@ TAG_DELTA = 14
 TAG_STOP = 15
 
 SERVER_RANK = 0  # rank-role convention (SURVEY.md §3.2 A6): rank 0 serves
+
+# Human-readable tag names for telemetry (obs counters/spans) — derived
+# from the constants so a renumbering cannot desynchronize the labels.
+_TAG_NAMES = {TAG_FETCH: "fetch", TAG_PARAM: "param", TAG_GRAD: "grad",
+              TAG_DELTA: "delta", TAG_STOP: "stop"}
 
 
 def pserver(
@@ -72,16 +78,22 @@ def pserver(
 
     stops = 0
     while stops < nclients:
-        st = mpiT.Probe(mpiT.ANY_SOURCE, mpiT.ANY_TAG)
+        with _obs.span("pserver:probe_wait"):
+            st = mpiT.Probe(mpiT.ANY_SOURCE, mpiT.ANY_TAG)
+        _obs.counter(
+            "ps_msgs", 1, role="server",
+            kind=_TAG_NAMES.get(st.tag, str(st.tag)),
+        )
         if st.tag == TAG_FETCH:
             mpiT.Recv(ctrl_buf, src=st.source, tag=TAG_FETCH)
             mpiT.Send(np.asarray(params, np.float32), dest=st.source, tag=TAG_PARAM)
         elif st.tag == TAG_GRAD:
             mpiT.Recv(grad_buf, src=st.source, tag=TAG_GRAD)
-            updates, opt_state = update(
-                jax.numpy.asarray(grad_buf), opt_state, params
-            )
-            params = apply(params, updates)
+            with _obs.span("pserver:apply_grad"):
+                updates, opt_state = update(
+                    jax.numpy.asarray(grad_buf), opt_state, params
+                )
+                params = apply(params, updates)
         elif st.tag == TAG_DELTA:
             mpiT.Recv(grad_buf, src=st.source, tag=TAG_DELTA)
             center = np.asarray(params, np.float32)
@@ -116,27 +128,36 @@ class PClient:
         self._step = 0
 
     def fetch(self) -> np.ndarray:
-        req = mpiT.Irecv(self._param_buf, src=self._server, tag=TAG_PARAM)
-        mpiT.Isend(
-            np.asarray([self._step], np.int32), dest=self._server, tag=TAG_FETCH
-        )
-        mpiT.Wait(req)
+        _obs.counter("ps_msgs", 1, role="client", kind="fetch")
+        with _obs.span("pclient:fetch"):
+            req = mpiT.Irecv(self._param_buf, src=self._server, tag=TAG_PARAM)
+            mpiT.Isend(
+                np.asarray([self._step], np.int32), dest=self._server,
+                tag=TAG_FETCH,
+            )
+            mpiT.Wait(req)
         return self._param_buf
 
     def push_grad(self, flat_grad: np.ndarray) -> None:
         self._step += 1
-        mpiT.Isend(
-            np.asarray(flat_grad, np.float32), dest=self._server, tag=TAG_GRAD
-        )
+        _obs.counter("ps_msgs", 1, role="client", kind="grad")
+        with _obs.span("pclient:push_grad"):
+            mpiT.Isend(
+                np.asarray(flat_grad, np.float32), dest=self._server,
+                tag=TAG_GRAD,
+            )
 
     def elastic_exchange(self, flat_params: np.ndarray, alpha: float) -> np.ndarray:
         """One EASGD round trip; returns the client's pulled params."""
         self._step += 1
-        req = mpiT.Irecv(self._param_buf, src=self._server, tag=TAG_PARAM)
-        mpiT.Isend(
-            np.asarray(flat_params, np.float32), dest=self._server, tag=TAG_DELTA
-        )
-        mpiT.Wait(req)
+        _obs.counter("ps_msgs", 1, role="client", kind="delta")
+        with _obs.span("pclient:elastic_exchange"):
+            req = mpiT.Irecv(self._param_buf, src=self._server, tag=TAG_PARAM)
+            mpiT.Isend(
+                np.asarray(flat_params, np.float32), dest=self._server,
+                tag=TAG_DELTA,
+            )
+            mpiT.Wait(req)
         center = self._param_buf
         return flat_params - alpha * (flat_params - center)
 

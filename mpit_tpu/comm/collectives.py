@@ -35,19 +35,74 @@ from jax import lax
 # Varying→invariant all-gather: the result is identical on every device and
 # is *marked* replicated for shard_map's VMA checker (plain lax.all_gather
 # returns a varying-typed value). Public in spirit; lives in _src in jax 0.9.
-from jax._src.lax.parallel import all_gather_invariant as _all_gather_invariant
+# Pre-VMA jax has no such op (nothing to mark) — the compat gate's plain
+# all_gather stands in.
+try:
+    from jax._src.lax.parallel import (
+        all_gather_invariant as _all_gather_invariant,
+    )
+except ImportError:
+    from mpit_tpu._jaxcompat import all_gather_invariant as _all_gather_invariant
 
 
 def _pvary(x, names):
     # Replicated→varying retype: jax 0.9's public spelling is
-    # lax.pcast(..., to='varying'); fall back to the deprecated lax.pvary.
+    # lax.pcast(..., to='varying'); fall back to the deprecated lax.pvary,
+    # and to identity on pre-VMA jax (nothing to retype for).
     if hasattr(lax, "pcast"):
         return lax.pcast(x, names, to="varying")
-    return lax.pvary(x, names)
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, names)
+    return x
 
 AxisName = str | Sequence[str]
 
 _REDUCE_OPS = ("sum", "mean", "max", "min", "prod")
+
+
+def _rec(op: str, x, axis: AxisName, *, model: str | None = None) -> None:
+    """Trace-time telemetry for a collective (mpit_tpu.obs; no-op when
+    obs is disabled — one global read).
+
+    Collectives here are *traceable* wrappers: this Python body runs
+    when XLA traces the enclosing jit/shard_map, not per device step —
+    so what accumulates is the program's modeled per-op wire traffic
+    (``utils.profiling.collective_bytes`` per trace), the trace-time
+    analogue of the CommModel accounting. ``model``: the wire-model
+    name (default ``op``); ``None`` payload models (permute/shift/
+    send_to/recv_from) charge the full buffer — each device forwards
+    its whole shard once.
+    """
+    from mpit_tpu.obs import core as _obs
+
+    if not _obs.enabled():
+        return
+    try:
+        names = axis_tuple(axis)
+        p = 1
+        for a in names:
+            p = p * lax.axis_size(a)
+        p = int(p)
+        payload = sum(
+            l.size * l.dtype.itemsize
+            for l in jax.tree.leaves(x)
+            if hasattr(l, "dtype")
+        )
+    except Exception:
+        return  # outside a mesh context / abstract axis: nothing to charge
+    from mpit_tpu.utils.profiling import collective_bytes
+
+    if model == "p2p":
+        wire = float(payload)
+    else:
+        wire = collective_bytes(payload, p, model or op)
+    axis_label = ",".join(names)
+    _obs.counter("collective_bytes", wire, op=op, axis=axis_label)
+    _obs.counter("collective_calls", 1, op=op, axis=axis_label)
+    _obs.instant(
+        f"collective:{op}", axis=axis_label, payload_bytes=payload,
+        wire_bytes_per_device=wire, devices=p,
+    )
 
 
 def axis_tuple(axis: AxisName) -> tuple[str, ...]:
@@ -105,6 +160,7 @@ def allreduce(x, axis: AxisName, *, op: str = "sum"):
     (SURVEY.md §4.3). Here: ``lax.psum``/``pmax``/``pmin`` lowered by XLA to
     an ICI ring; everyone receives the reduced value.
     """
+    _rec("allreduce", x, axis)
     if op == "sum":
         return lax.psum(x, axis)
     if op == "mean":
@@ -126,6 +182,7 @@ def allreduce(x, axis: AxisName, *, op: str = "sum"):
 
 def pmean(x, axis: AxisName):
     """Mean-allreduce; the gradient-averaging spelling of :func:`allreduce`."""
+    _rec("pmean", x, axis, model="allreduce")
     return lax.pmean(x, axis)
 
 
@@ -136,7 +193,7 @@ def reduce(x, axis: str, *, root: int = 0, op: str = "sum"):
     the allreduce and non-root devices get **zeros** (a defined, testable
     contract). If every device needs the value, use :func:`allreduce`.
     """
-    y = allreduce(x, axis, op=op)
+    y = allreduce(x, axis, op=op)  # (charged there as an allreduce)
     is_root = jnp.broadcast_to(rank(axis) == root, y.shape)
     return lax.select(is_root, y, jnp.zeros_like(y))
 
@@ -156,6 +213,7 @@ def broadcast(x, axis: str, *, root: int = 0):
     CollectiveBroadcast HLO) was evaluated and rejected: jax 0.9 has no
     MLIR lowering for it on either the CPU test mesh *or* this TPU stack.
     """
+    _rec("broadcast", x, axis)
     is_root = jnp.broadcast_to(rank(axis) == root, x.shape)
     return lax.psum(lax.select(is_root, x, jnp.zeros_like(x)), axis)
 
@@ -176,6 +234,7 @@ def allgather(
     VMA checker — use when the gathered value leaves the shard_map with a
     replicated out_spec.
     """
+    _rec("allgather", x, axis, model="all_gather")
     if invariant:
         return _all_gather_invariant(x, axis, axis=gather_axis, tiled=tiled)
     return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
@@ -188,11 +247,13 @@ def reduce_scatter(x, axis: str, *, scatter_axis: int = 0, tiled: bool = True):
     ("goo optimizer state sharded across chips", BASELINE.json): each device
     receives one reduced shard of ``x`` along ``scatter_axis``.
     """
+    _rec("reduce_scatter", x, axis)
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=tiled)
 
 
 def alltoall(x, axis: str, *, split_axis: int, concat_axis: int, tiled: bool = False):
     """All-to-all — the Ulysses sequence↔head redistribution primitive."""
+    _rec("alltoall", x, axis)
     return lax.all_to_all(
         x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
     )
@@ -207,6 +268,7 @@ def permute(x, axis: str, perm: Sequence[tuple[int, int]]):
     stages, ring neighbors); dynamic ``ANY_SOURCE`` patterns have no SPMD
     equivalent (SURVEY.md §8.4) and collapse at a higher level instead.
     """
+    _rec("permute", x, axis, model="p2p")
     return lax.ppermute(x, axis, perm=list(perm))
 
 
@@ -216,6 +278,7 @@ def shift(x, axis: str, *, offset: int = 1, wrap: bool = True):
     The building block of ring pipelines (pipeline parallelism, ring
     attention). ``wrap=False`` leaves edge devices holding zeros.
     """
+    _rec("shift", x, axis, model="p2p")
     n = lax.axis_size(axis)
     if wrap:
         perm = [(i, (i + offset) % n) for i in range(n)]
@@ -231,6 +294,7 @@ def send_to(x, axis: str, dest: Sequence[int]):
     pattern is known at trace time. ``dest`` must be a permutation of
     ``range(size(axis))``; devices that nobody sends to receive zeros.
     """
+    _rec("send_to", x, axis, model="p2p")
     n = len(dest)
     perm = [(i, int(dest[i])) for i in range(n)]
     return lax.ppermute(x, axis, perm=perm)
@@ -238,6 +302,7 @@ def send_to(x, axis: str, dest: Sequence[int]):
 
 def recv_from(x, axis: str, src: Sequence[int]):
     """Static gather-receive: device ``i`` receives ``x`` from ``src[i]``."""
+    _rec("recv_from", x, axis, model="p2p")
     n = len(src)
     perm = [(int(src[i]), i) for i in range(n)]
     return lax.ppermute(x, axis, perm=perm)
@@ -251,6 +316,7 @@ def barrier(axis: AxisName, token=None):
     ``optimization_barrier`` so the collective cannot be elided or hoisted.
     Returns ``token`` (or the psum result if no token given).
     """
+    _rec("barrier", jnp.ones((), dtype=jnp.int32), axis, model="allreduce")
     fence = lax.psum(jnp.ones((), dtype=jnp.int32), axis)
     if token is None:
         return fence

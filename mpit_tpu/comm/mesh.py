@@ -233,19 +233,21 @@ def init(
             f"mesh shape {axis_shapes} does not cover {ndev} devices"
         )
 
-    # AxisType.Auto throughout: this framework is shard_map-centric, and
-    # jax 0.9's make_mesh default of Explicit leaks sharding-in-types avals
+    # AxisType.Auto throughout (via the compat gate, which also handles
+    # pre-AxisType jax): this framework is shard_map-centric, and jax
+    # 0.9's make_mesh default of Explicit leaks sharding-in-types avals
     # into host-level ops outside a mesh context.
-    axis_types = (jax.sharding.AxisType.Auto,) * len(axis_shapes)
+    from mpit_tpu import _jaxcompat
+
     if devices is None:
         # Topology-aware layout (ICI-friendly): jax.make_mesh reorders
         # devices so the innermost axes land on physical neighbors.
-        mesh = jax.make_mesh(
-            tuple(axis_shapes.values()), tuple(axis_shapes.keys()), axis_types
+        mesh = _jaxcompat.make_mesh(
+            tuple(axis_shapes.values()), tuple(axis_shapes.keys())
         )
     else:
         dev_array = np.asarray(devs).reshape(tuple(axis_shapes.values()))
-        mesh = Mesh(dev_array, tuple(axis_shapes.keys()), axis_types=axis_types)
+        mesh = _jaxcompat.mesh_from_devices(dev_array, tuple(axis_shapes.keys()))
 
     world = World(mesh=mesh)
     if set_default:
@@ -343,8 +345,9 @@ def init_hybrid(
     arr = arr.transpose(perm).reshape(
         tuple(d * c for d, c in zip(dcn_sizes, ici_sizes))
     )
-    axis_types = (jax.sharding.AxisType.Auto,) * k
-    mesh = Mesh(arr, tuple(names), axis_types=axis_types)
+    from mpit_tpu import _jaxcompat
+
+    mesh = _jaxcompat.mesh_from_devices(arr, tuple(names))
     world = World(mesh=mesh, dcn_axes=dcn_axes or None)
     if set_default:
         global _DEFAULT_WORLD

@@ -29,6 +29,8 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
+from mpit_tpu.obs import core as _obs
+
 # ---------------------------------------------------------------------------
 # Constants — the mpiT.* constant surface (SURVEY.md §3.1 C1).
 # ---------------------------------------------------------------------------
@@ -143,6 +145,15 @@ class Request:
         self._buf[...] = flat.reshape(self._buf.shape)
         self.status = Status(source=msg.src, tag=msg.tag, count=flat.size)
         self._done = True
+        if _obs.enabled():
+            # Receive-side accounting: counts at DELIVERY (the matching
+            # moment), which may run on the sender's thread via put() —
+            # the obs counters are global and thread-safe, and the obs
+            # lock never nests inside the mailbox lock the other way.
+            _obs.counter(
+                "p2p_recv_bytes", flat.nbytes, src=msg.src, dst=self._rank
+            )
+            _obs.counter("p2p_recv_msgs", 1, src=msg.src, dst=self._rank)
 
     def wait(self) -> Status | None:
         """Block until complete — ``mpiT.Wait`` analogue."""
@@ -372,6 +383,11 @@ def Send(buf, dest: int, tag: int = 0, comm: Comm | None = None) -> None:
     rank, _ = _require_ctx()
     c = _resolve(comm)
     data = np.array(np.asarray(buf), copy=True)
+    if _obs.enabled():
+        # Send-side traffic accounting (mpit_tpu.obs): the rank×rank
+        # byte matrix for parity runs (obs.traffic_matrix) reads these.
+        _obs.counter("p2p_send_bytes", data.nbytes, src=rank, dst=dest)
+        _obs.counter("p2p_send_msgs", 1, src=rank, dst=dest)
     c._boxes[dest].put(_Message(rank, tag, data))
 
 

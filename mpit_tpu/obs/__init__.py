@@ -1,0 +1,71 @@
+"""mpit_tpu.obs — unified runtime telemetry: spans, counters, exporters.
+
+The reference's observability is per-rank ``print()`` timers (SURVEY.md
+§6); this repo grew better pieces (``utils.profiling.StepTimer``/
+``CommModel``, ``train.metrics.MetricLogger``) but nothing that records
+*where a step's wall time goes* or attributes comm traffic to individual
+operations. This package is that layer:
+
+- :func:`span` — a context manager timing a named phase, with near-zero
+  overhead when disabled (a shared no-op object, no allocation beyond
+  the call itself);
+- :func:`counter` / :func:`gauge` — monotonic accumulators and
+  last-value gauges, keyed by name + attributes (thread-safe);
+- a process-global :class:`~mpit_tpu.obs.core.Recorder` buffering
+  events in memory; :func:`enable` / :func:`disable` install/remove it;
+- exporters: :func:`export_chrome_trace` (Chrome-trace/Perfetto JSON,
+  loadable in ``ui.perfetto.dev`` — complementing the XPlane capture of
+  ``utils.profiling.trace``) and :func:`export_jsonl` (one record per
+  event, written through ``MetricLogger`` so the record shape is
+  literally the metrics-stream shape);
+- :func:`summary` — rolls spans into ``{phase: {count, total_s, p50_s,
+  p95_s}}`` plus the top-N collectives by modeled wire bytes;
+- :func:`traffic_matrix` — the rank×rank P2P byte matrix accumulated by
+  the :mod:`mpit_tpu.compat` simulator for parity runs.
+
+Instrumented call sites: ``train.loop.hardened_loop`` (prefetch-wait /
+step / host-fence / eval / checkpoint / divergence-restore phases),
+``comm.collectives`` (per-op modeled wire bytes — recorded at *trace*
+time, when the collective's Python wrapper runs), ``compat.simulator``
+(per-rank send/recv bytes), ``asyncsgd.actors`` (protocol message
+counts), and ``bench.py`` (per-workload phase breakdown in
+``BENCH_DETAIL.json``).
+
+Everything is import-light: nothing here touches jax, so the disabled
+fast path costs a module-global check and the package can be imported
+from anywhere in the stack without cycles.
+"""
+
+from mpit_tpu.obs.core import (
+    Recorder,
+    counter,
+    disable,
+    enable,
+    enabled,
+    gauge,
+    get_recorder,
+    instant,
+    span,
+    summary,
+)
+from mpit_tpu.obs.export import (
+    export_chrome_trace,
+    export_jsonl,
+    traffic_matrix,
+)
+
+__all__ = [
+    "Recorder",
+    "counter",
+    "disable",
+    "enable",
+    "enabled",
+    "export_chrome_trace",
+    "export_jsonl",
+    "gauge",
+    "get_recorder",
+    "instant",
+    "span",
+    "summary",
+    "traffic_matrix",
+]

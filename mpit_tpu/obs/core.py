@@ -1,0 +1,315 @@
+"""Event core: spans, counters, gauges, and the process-global Recorder.
+
+Design constraints (ISSUE 1 tentpole):
+
+- **Near-zero overhead when disabled.** The fast path of every primitive
+  is one module-global read. :func:`span` returns a shared no-op context
+  manager object when disabled — no allocation, no lock, no clock read —
+  so instrumenting a hot loop costs nanoseconds until someone calls
+  :func:`enable`.
+- **Thread-safe.** Spans come from the training thread, the prefetch
+  thread, the simulator's rank threads, and bench's watchdog
+  concurrently; one lock guards the buffers, taken only when enabled.
+- **In-memory buffering.** Events are plain tuples in a list; export is
+  a separate, explicit step (``obs.export``). A long run at a
+  reasonable instrumentation density (tens of events per step) stays in
+  the tens of MB; ``max_events`` caps pathological producers by
+  dropping (and counting) the overflow rather than growing unbounded.
+
+Event model:
+
+- a *span* is ``(name, t0, dur, tid, attrs)`` — a named wall-clock
+  interval on a thread (``t0`` seconds since the recorder's epoch);
+- an *instant* is a zero-duration marker (``dur = 0.0``, kind "i") —
+  used e.g. by ``comm.collectives`` to mark trace-time op recording;
+- *counters* accumulate ``float`` values keyed by ``(name, attrs)`` —
+  monotonic by convention (the exporters render them as Chrome "C"
+  events); *gauges* keep the last value instead.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Iterator, Mapping
+
+import numpy as np
+
+__all__ = [
+    "Recorder",
+    "counter",
+    "disable",
+    "enable",
+    "enabled",
+    "gauge",
+    "get_recorder",
+    "instant",
+    "span",
+    "summary",
+]
+
+
+def _attr_key(attrs: Mapping[str, Any] | None) -> tuple:
+    """Canonical hashable key for an attribute set."""
+    if not attrs:
+        return ()
+    return tuple(sorted(attrs.items()))
+
+
+class Recorder:
+    """Thread-safe in-memory event buffer.
+
+    One process-global instance is installed by :func:`enable`; library
+    code reaches it only through the module-level primitives so the
+    disabled fast path stays a single global read.
+    """
+
+    def __init__(self, *, max_events: int = 2_000_000):
+        self._lock = threading.Lock()
+        self._epoch = time.perf_counter()
+        self._max_events = max_events
+        self.dropped = 0
+        # span/instant tuples: (kind, name, t0_s, dur_s, tid, attrs|None)
+        self.events: list[tuple] = []
+        self.counters: dict[tuple[str, tuple], float] = {}
+        self.gauges: dict[tuple[str, tuple], float] = {}
+        self._thread_names: dict[int, str] = {}
+
+    # -- recording (called via the module-level primitives) -----------------
+    def add_span(
+        self, name: str, t0: float, t1: float, attrs: Mapping | None = None
+    ) -> None:
+        th = threading.current_thread()
+        with self._lock:
+            if len(self.events) >= self._max_events:
+                self.dropped += 1
+                return
+            self._thread_names.setdefault(th.ident, th.name)
+            self.events.append(
+                ("X", name, t0 - self._epoch, t1 - t0, th.ident, attrs)
+            )
+
+    def add_instant(self, name: str, attrs: Mapping | None = None) -> None:
+        th = threading.current_thread()
+        with self._lock:
+            if len(self.events) >= self._max_events:
+                self.dropped += 1
+                return
+            self._thread_names.setdefault(th.ident, th.name)
+            self.events.append(
+                ("i", name, time.perf_counter() - self._epoch, 0.0,
+                 th.ident, attrs)
+            )
+
+    def add_counter(
+        self, name: str, value: float, attrs: Mapping | None = None
+    ) -> None:
+        key = (name, _attr_key(attrs))
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0.0) + float(value)
+
+    def add_gauge(
+        self, name: str, value: float, attrs: Mapping | None = None
+    ) -> None:
+        with self._lock:
+            self.gauges[(name, _attr_key(attrs))] = float(value)
+
+    # -- reading ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Consistent copy of all buffers (for exporters)."""
+        with self._lock:
+            return {
+                "events": list(self.events),
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "thread_names": dict(self._thread_names),
+                "dropped": self.dropped,
+            }
+
+    def counter_items(self, name: str) -> Iterator[tuple[dict, float]]:
+        """(attrs dict, value) pairs for every counter named ``name``."""
+        with self._lock:
+            items = [
+                (dict(k[1]), v) for k, v in self.counters.items()
+                if k[0] == name
+            ]
+        return iter(items)
+
+    def counter_total(self, name: str) -> float:
+        """Sum of a counter across all attribute sets."""
+        with self._lock:
+            return sum(v for k, v in self.counters.items() if k[0] == name)
+
+    def drain(self) -> dict:
+        """Snapshot AND clear — bench.py's per-workload phase breakdown
+        uses this so each workload's events don't bleed into the next."""
+        with self._lock:
+            out = {
+                "events": self.events,
+                "counters": self.counters,
+                "gauges": self.gauges,
+                "thread_names": dict(self._thread_names),
+                "dropped": self.dropped,
+            }
+            self.events = []
+            self.counters = {}
+            self.gauges = {}
+            self.dropped = 0
+        return out
+
+    def summary(self, *, top_collectives: int = 5) -> dict:
+        """Roll events into ``{"phases": {name: {count, total_s, p50_s,
+        p95_s}}, "collectives": [...], "counters": {...}}``.
+
+        ``collectives`` lists the top-N ops by accumulated modeled wire
+        bytes (the ``collective_bytes`` counter written by
+        ``comm.collectives``), most traffic first.
+        """
+        snap = self.snapshot()
+        by_name: dict[str, list[float]] = {}
+        for kind, name, _t0, dur, _tid, _attrs in snap["events"]:
+            if kind == "X":
+                by_name.setdefault(name, []).append(dur)
+        phases = {}
+        for name, durs in sorted(by_name.items()):
+            arr = np.asarray(durs)
+            phases[name] = {
+                "count": int(arr.size),
+                "total_s": float(arr.sum()),
+                "p50_s": float(np.percentile(arr, 50)),
+                "p95_s": float(np.percentile(arr, 95)),
+            }
+        colls = [
+            ({**dict(k[1])}, v)
+            for k, v in snap["counters"].items()
+            if k[0] == "collective_bytes"
+        ]
+        colls.sort(key=lambda kv: kv[1], reverse=True)
+        collectives = [
+            {**attrs, "wire_bytes": v}
+            for attrs, v in colls[:top_collectives]
+        ]
+        counters = {}
+        for (name, _akey), v in snap["counters"].items():
+            counters[name] = counters.get(name, 0.0) + v
+        out = {"phases": phases, "collectives": collectives,
+               "counters": counters}
+        if snap["dropped"]:
+            out["dropped_events"] = snap["dropped"]
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Process-global switch + the primitives library code calls.
+# ---------------------------------------------------------------------------
+
+_RECORDER: Recorder | None = None
+_LOCK = threading.Lock()
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager — the disabled fast path. A
+    single instance is reused, so a disabled ``span()`` call allocates
+    nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    """Live span: times ``__enter__``..``__exit__`` and records on exit.
+
+    Re-checks the global on exit so a recorder swapped out mid-span
+    can't resurrect; events land in whichever recorder is installed at
+    exit time (good enough for a debugging layer, and lock-free on the
+    span object itself)."""
+
+    __slots__ = ("name", "attrs", "t0")
+
+    def __init__(self, name: str, attrs: Mapping | None):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        rec = _RECORDER
+        if rec is not None:
+            rec.add_span(self.name, self.t0, time.perf_counter(), self.attrs)
+        return False
+
+
+def enable(recorder: Recorder | None = None) -> Recorder:
+    """Install (and return) the process-global recorder. Idempotent when
+    one is already installed and none is passed."""
+    global _RECORDER
+    with _LOCK:
+        if recorder is not None:
+            _RECORDER = recorder
+        elif _RECORDER is None:
+            _RECORDER = Recorder()
+        return _RECORDER
+
+
+def disable() -> None:
+    """Remove the process-global recorder; primitives return to the
+    no-op fast path. The recorder object (and its events) survive for
+    export if the caller kept a reference."""
+    global _RECORDER
+    with _LOCK:
+        _RECORDER = None
+
+
+def enabled() -> bool:
+    return _RECORDER is not None
+
+
+def get_recorder() -> Recorder | None:
+    return _RECORDER
+
+
+def span(name: str, **attrs):
+    """Context manager timing a named phase. Disabled: returns the
+    shared no-op instance (no allocation)."""
+    if _RECORDER is None:
+        return _NOOP
+    return _Span(name, attrs or None)
+
+
+def instant(name: str, **attrs) -> None:
+    """Zero-duration marker event."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.add_instant(name, attrs or None)
+
+
+def counter(name: str, value: float = 1.0, **attrs) -> None:
+    """Accumulate ``value`` onto the counter keyed by name + attrs."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.add_counter(name, value, attrs or None)
+
+
+def gauge(name: str, value: float, **attrs) -> None:
+    """Set the last-value gauge keyed by name + attrs."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.add_gauge(name, value, attrs or None)
+
+
+def summary(*, top_collectives: int = 5) -> dict:
+    """Summary of the installed recorder ({} when disabled)."""
+    rec = _RECORDER
+    if rec is None:
+        return {}
+    return rec.summary(top_collectives=top_collectives)

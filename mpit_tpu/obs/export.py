@@ -1,0 +1,177 @@
+"""Exporters: Chrome-trace/Perfetto JSON, MetricLogger-shaped JSONL, and
+the simulator's rank×rank traffic matrix.
+
+The Chrome trace complements (does not replace) the XPlane capture of
+``utils.profiling.trace``: XPlane sees inside XLA (per-op device time);
+this timeline sees the *host-side anatomy of the run* — where a step's
+wall clock goes between prefetch wait, dispatch, host fences, eval,
+checkpoint and recovery — which XPlane cannot attribute.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from mpit_tpu.obs import core
+
+
+def _require(recorder: core.Recorder | None) -> core.Recorder:
+    rec = recorder or core.get_recorder()
+    if rec is None:
+        raise RuntimeError(
+            "obs is disabled and no recorder was passed — call "
+            "obs.enable() before the run, or pass the Recorder explicitly"
+        )
+    return rec
+
+
+def chrome_trace_events(
+    recorder: core.Recorder | None = None, *, pid: int | None = None
+) -> list[dict]:
+    """The ``traceEvents`` list (Chrome trace event format).
+
+    Spans become complete ("X") events, instants "i", counters one "C"
+    sample per counter series; thread-name metadata ("M") rows make the
+    Perfetto track names readable. Timestamps are µs since the
+    recorder's epoch.
+    """
+    rec = _require(recorder)
+    snap = rec.snapshot()
+    if pid is None:
+        pid = 0
+        try:  # process_index when jax is up; obs itself never needs jax
+            import jax
+
+            pid = jax.process_index()
+        except Exception:
+            pass
+    events: list[dict] = []
+    for tid, name in sorted(snap["thread_names"].items()):
+        events.append(
+            {"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+             "args": {"name": name}}
+        )
+    last_ts = 0.0
+    for kind, name, t0, dur, tid, attrs in snap["events"]:
+        ev: dict[str, Any] = {
+            "ph": kind,
+            "name": name,
+            "cat": "obs",
+            "pid": pid,
+            "tid": tid,
+            "ts": round(t0 * 1e6, 3),
+        }
+        if kind == "X":
+            ev["dur"] = round(dur * 1e6, 3)
+        if kind == "i":
+            ev["s"] = "t"  # instant scope: thread
+        if attrs:
+            ev["args"] = dict(attrs)
+        events.append(ev)
+        last_ts = max(last_ts, (t0 + dur) * 1e6)
+    # One "C" sample per counter series at the end of the trace — the
+    # accumulated totals, attribute sets as separate series.
+    for (name, akey), value in sorted(snap["counters"].items()):
+        label = name if not akey else (
+            name + "{" + ",".join(f"{k}={v}" for k, v in akey) + "}"
+        )
+        events.append(
+            {"ph": "C", "name": label, "pid": pid, "ts": round(last_ts, 3),
+             "args": {"value": value}}
+        )
+    return events
+
+
+def export_chrome_trace(
+    path: str | Path, recorder: core.Recorder | None = None
+) -> Path:
+    """Write a Perfetto-loadable Chrome-trace JSON file and return its
+    path (load at ``ui.perfetto.dev`` or ``chrome://tracing``)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    doc = {
+        "traceEvents": chrome_trace_events(recorder),
+        "displayTimeUnit": "ms",
+    }
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    tmp.replace(path)
+    return path
+
+
+def export_jsonl(
+    path: str | Path, recorder: core.Recorder | None = None
+) -> Path:
+    """Write one MetricLogger-shaped record per event (and one per
+    counter/gauge series) — the same ``{"step": ..., k: float(v)}``
+    JSONL shape the metrics stream uses, so downstream tooling reads
+    both streams with one parser. ``step`` is the event index."""
+    from mpit_tpu.train.metrics import MetricLogger
+
+    rec = _require(recorder)
+    snap = rec.snapshot()
+    path = Path(path)
+    logger = MetricLogger(path, stdout=False)
+    try:
+        i = 0
+        for kind, name, t0, dur, _tid, attrs in snap["events"]:
+            record = {"event": "span" if kind == "X" else "instant",
+                      "name": name, "t0_s": round(t0, 6),
+                      "dur_s": round(dur, 6)}
+            if attrs:
+                # Attrs must not clobber the record's own fields — nor
+                # "step", which MetricLogger.log itself assigns (an attr
+                # literally named "step" would overwrite the event index).
+                record.update(
+                    {k: v for k, v in attrs.items()
+                     if k not in record and k != "step"}
+                )
+            logger.log(i, record)
+            i += 1
+        for kind, series in (("counter", snap["counters"]),
+                             ("gauge", snap["gauges"])):
+            for (name, akey), value in sorted(series.items()):
+                record = {"event": kind, "name": name, "value": value}
+                # Same clobber guard as the span path: attrs must not
+                # overwrite the record's own fields or "step".
+                record.update(
+                    {k: v for k, v in akey
+                     if k not in record and k != "step"}
+                )
+                logger.log(i, record)
+                i += 1
+    finally:
+        logger.close()
+    return path
+
+
+def traffic_matrix(
+    nranks: int | None = None,
+    recorder: core.Recorder | None = None,
+    *,
+    counter: str = "p2p_send_bytes",
+) -> np.ndarray:
+    """Rank×rank byte matrix from the simulator's P2P counters.
+
+    ``M[src, dst]`` = bytes ``src`` sent to ``dst`` (for the default
+    send-side counter). For a parameter-server parity run the server
+    row (params out) and column (grads in) dominate — the protocol's
+    traffic shape made visible. ``nranks`` defaults to 1 + the largest
+    rank observed."""
+    rec = _require(recorder)
+    items = list(rec.counter_items(counter))
+    if nranks is None:
+        nranks = 1 + max(
+            (max(int(a["src"]), int(a["dst"])) for a, _ in items), default=-1
+        )
+    m = np.zeros((nranks, nranks), dtype=np.float64)
+    for attrs, value in items:
+        src, dst = int(attrs["src"]), int(attrs["dst"])
+        if src < nranks and dst < nranks:
+            m[src, dst] += value
+    return m

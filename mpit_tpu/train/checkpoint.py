@@ -39,6 +39,7 @@ class CheckpointManager:
     ):
         self._world = world
         self._dir = Path(directory).absolute()
+        self._pending_meta: dict | None = None
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
@@ -121,12 +122,18 @@ class CheckpointManager:
                     "implicitly ran the old default) cannot be validated",
                     stacklevel=2,
                 )
-            if unrecorded and jax.process_index() == 0:
-                merged = {**recorded, **unrecorded}
-                tmp = path.with_suffix(".json.tmp")
-                with open(tmp, "w") as f:
-                    json.dump(merged, f, indent=1)
-                os.replace(tmp, path)
+            if unrecorded:
+                # Deferred merge (round-5 advisor finding): do NOT write
+                # the widened meta yet. Pinning here — before the restore
+                # has succeeded — records this run's values for fields
+                # the original run never declared, so a failed/aborted
+                # resume (e.g. a pre-round-5 flax-BN checkpoint first
+                # retried with the wrong --bn-impl) poisons run_meta.json
+                # and the *corrected* retry then fails validation against
+                # geometry that was only ever attempted. The merge lands
+                # after the first successful restore() (or first save(),
+                # for callers that validate without restoring).
+                self._pending_meta = {**recorded, **unrecorded}
             return
         if not path.exists() and self.latest_step() is not None:
             # Pre-upgrade directory (checkpoint written before run-meta
@@ -149,8 +156,24 @@ class CheckpointManager:
                 json.dump(meta, f, indent=1)
             os.replace(tmp, path)  # atomic: no partial file is ever visible
 
+    def _flush_pending_meta(self) -> None:
+        """Write the deferred ensure_meta merge (see its docstring): the
+        run has now demonstrably worked against this directory, so the
+        widened geometry can be pinned. Process 0 writes; atomic."""
+        merged, self._pending_meta = self._pending_meta, None
+        if merged is None or jax.process_index() != 0:
+            return
+        path = self._dir / "run_meta.json"
+        tmp = path.with_suffix(".json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(merged, f, indent=1)
+        os.replace(tmp, path)
+
     def save(self, step: int, state: Any) -> None:
         self._mgr.save(step, args=ocp.args.StandardSave(state))
+        # AFTER the save is accepted: a first save that raises must not
+        # pin attempted-only geometry (same rule as restore()).
+        self._flush_pending_meta()
 
     def restore(self, state_like: Any, specs: Any, *, step: int | None = None):
         """Restore the checkpoint at ``step`` (default: latest).
@@ -175,7 +198,11 @@ class CheckpointManager:
             state_like,
             specs,
         )
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        out = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        # Restore succeeded: safe to pin any geometry fields ensure_meta
+        # deferred (a failed restore must leave run_meta.json untouched).
+        self._flush_pending_meta()
+        return out
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

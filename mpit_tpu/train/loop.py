@@ -21,6 +21,7 @@ from typing import Any, Callable, Iterator
 
 import jax
 
+from mpit_tpu import obs
 from mpit_tpu.data.loader import Prefetcher
 from mpit_tpu.train.guard import Diverged, DivergenceGuard
 from mpit_tpu.train.metrics import MetricLogger, Throughput
@@ -150,14 +151,24 @@ def hardened_loop(
     step = start_step
     try:
         with Prefetcher(world, batches, axis=axis, transform=transform) as stream:
-            for batch in stream:
+            while True:
+                # Telemetry (mpit_tpu.obs, no-op unless obs.enable()d):
+                # the loop's phases are spanned so a Chrome-trace export
+                # shows where each step's wall clock went — prefetch
+                # wait vs dispatch vs host fence vs eval/checkpoint.
+                with obs.span("prefetch_wait"):
+                    try:
+                        batch = next(stream)
+                    except StopIteration:
+                        break
                 if step >= steps:
                     break
                 if preempted["flag"]:
                     if ckpt:
-                        if ckpt.latest_step() != step:  # cadence saved it
-                            ckpt.save(step, state)
-                        ckpt.wait()
+                        with obs.span("checkpoint_save", reason="preempted"):
+                            if ckpt.latest_step() != step:  # cadence saved it
+                                ckpt.save(step, state)
+                            ckpt.wait()
                     logger.log(
                         step,
                         {"event": "preempted_checkpoint_and_exit",
@@ -172,9 +183,11 @@ def hardened_loop(
                 ):
                     jax.profiler.start_trace(profile_dir)
                     tracing = True
-                state, metrics = step_fn(state, batch)
+                with obs.span("step"):
+                    state, metrics = step_fn(state, batch)
                 if tracing and step >= prof_window[1]:
-                    float(metrics["loss"])  # host fetch: trace covers real work
+                    with obs.span("host_fence", why="trace_window"):
+                        float(metrics["loss"])  # host fetch: trace covers real work
                     jax.profiler.stop_trace()
                     tracing = False
                     trace_done = True
@@ -190,9 +203,11 @@ def hardened_loop(
                 if not (should_log or should_save) and (
                     dispatch_fence and (step + 1) % dispatch_fence == 0
                 ):
-                    float(metrics["loss"])  # bound async-dispatch depth
+                    with obs.span("host_fence", why="dispatch_fence"):
+                        float(metrics["loss"])  # bound async-dispatch depth
                 if should_log or should_save:
-                    loss = float(metrics["loss"])
+                    with obs.span("host_fence", why="log"):
+                        loss = float(metrics["loss"])
                     try:
                         guard_.check(step + 1, loss)
                     except Diverged:
@@ -213,11 +228,18 @@ def hardened_loop(
                             jax.profiler.stop_trace()
                             tracing = False
                             trace_done = True
-                        state = ckpt.restore(state, specs(), step=target)
+                        with obs.span("divergence_restore", target=target):
+                            state = ckpt.restore(state, specs(), step=target)
                         step = int(state.step)
                         restore_before = target
                         guard_.reset()
                         loss_trace = [(s, l) for s, l in loss_trace if s <= step]
+                        # Throughput bookkeeping must not straddle the
+                        # rollback: the step counter just jumped backward,
+                        # so a live log window would compute a NEGATIVE
+                        # items_per_sec for the first post-restore log
+                        # (round-5 advisor finding). Start a fresh window.
+                        log_t, log_step = None, step
                         logger.log(
                             step,
                             {"event": "restored_after_divergence",
@@ -243,12 +265,14 @@ def hardened_loop(
                         log_t, log_step = now, step + 1
                         logger.log(step + 1, out)
                     if should_save:
-                        ckpt.save(step + 1, state)
+                        with obs.span("checkpoint_save"):
+                            ckpt.save(step + 1, state)
                         # A new guard-passing checkpoint supersedes the
                         # poisoned-latest suspicion from a past restore.
                         restore_before = None
                 if should_eval:
-                    last_eval = eval_hook(state)
+                    with obs.span("eval"):
+                        last_eval = eval_hook(state)
                     if last_eval:
                         logger.log(
                             step + 1,
@@ -269,14 +293,15 @@ def hardened_loop(
                 prev_handler if prev_handler is not None else signal.SIG_DFL,
             )
     if ckpt:
-        if (
-            final_save
-            and not preempted["flag"]
-            and step > start_step
-            and ckpt.latest_step() != step  # cadence already saved here
-        ):
-            ckpt.save(step, state)
-        ckpt.wait()
+        with obs.span("checkpoint_save", reason="final"):
+            if (
+                final_save
+                and not preempted["flag"]
+                and step > start_step
+                and ckpt.latest_step() != step  # cadence already saved here
+            ):
+                ckpt.save(step, state)
+            ckpt.wait()
 
     losses = [l for _, l in loss_trace]
     out = {
@@ -295,6 +320,19 @@ def hardened_loop(
         out["items_per_sec_last"] = round(rate_trace[-1], 2)
     if last_eval:  # an empty sweep (val split < one batch) records nothing
         out["eval"] = last_eval
+    if obs.enabled():
+        # End-of-run roll-up (ISSUE 1 tentpole): phase totals + top
+        # collectives by modeled wire bytes, logged so the JSONL stream
+        # carries the breakdown, and attached to the result for callers
+        # (bench, rehearsal scripts) to persist. The full timeline is
+        # the caller's to export (obs.export_chrome_trace).
+        out["obs"] = obs.summary()
+        totals = {
+            f"obs_{name}_total_s": round(p["total_s"], 4)
+            for name, p in out["obs"]["phases"].items()
+        }
+        if totals:
+            logger.log(step, {"event": "obs_summary", **totals})
     return out
 
 

@@ -15,10 +15,23 @@ import bench
 
 def _realistic_results():
     """Canned per-workload dicts shaped like real bench_* returns, with
-    worst-case-width numbers (large floats, every optional key present)."""
+    worst-case-width numbers (large floats, every optional key present).
+    ``phases`` mirrors the obs phase breakdown main() now attaches to
+    every workload (detail-file-only, like ``scaling``)."""
     scaling = {
         "single_slice": {"modeled": True, "assumptions": {"x": 1.0} , "points": [1] * 12},
         "slice64": {"modeled": True, "assumptions": {"x": 1.0}, "points": [1] * 12},
+    }
+    phases = {
+        "workload": {"count": 1, "total_s": 123.456},
+        "staging": {"count": 4, "total_s": 45.678},
+        "warmup": {"count": 1, "total_s": 12.345},
+        "timed_window": {"count": 12, "total_s": 34.567},
+        "top_collectives": [
+            {"op": "reduce_scatter", "axis": "data", "wire_bytes": 213313608.2},
+            {"op": "allgather", "axis": "data", "wire_bytes": 213313608.2},
+            {"op": "allreduce", "axis": "data", "wire_bytes": 1024.0},
+        ],
     }
     return {
         "alexnet": {
@@ -32,6 +45,7 @@ def _realistic_results():
             "final_loss": 6.9078,
             "grad_sync_bytes_per_step_modeled": 243786980.0,
             "scaling": scaling,
+            "phases": phases,
         },
         "resnet50": {
             "images_per_sec": 12345.67,
@@ -42,6 +56,7 @@ def _realistic_results():
             "scan_steps": 2,
             "final_loss": 6.9088,
             "scaling": scaling,
+            "phases": phases,
         },
         "gpt2": {
             "tokens_per_sec": 130301.5,
@@ -53,6 +68,7 @@ def _realistic_results():
             "attention": "pallas-flash",
             "final_loss": 10.8262,
             "scaling": scaling,
+            "phases": phases,
         },
         "gpt2_moe": {
             "tokens_per_sec": 46123.9,
@@ -67,12 +83,14 @@ def _realistic_results():
             "dispatch": "sort-ragged",
             "drop_rate_per_moe_layer": [0.3123] * 6,
             "final_loss": 10.9262,
+            "phases": phases,
         },
         "allreduce": {
             "gbps": 51.43,
             "modeled": True,
             "devices": 8,
             "note": "1 device: no-op collective; ICI-roofline estimate",
+            "phases": phases,
         },
     }
 
@@ -107,6 +125,10 @@ class TestLineBudget:
         # Bulky blobs must NOT ride the line.
         assert "scaling" not in rec["detail"]["alexnet"]
         assert "drop_rate_per_moe_layer" not in rec["detail"]["gpt2_moe"]
+        # The obs phase breakdown is detail-file-only too (ISSUE 1).
+        for wl in rec["detail"].values():
+            if isinstance(wl, dict):
+                assert "phases" not in wl
 
     def test_partial_record_parses(self):
         # Progressive emission: record printed after the headline only,

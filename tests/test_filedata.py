@@ -127,9 +127,13 @@ class TestWorkloadIntegration:
 
         from mpit_tpu.asyncsgd import mnist as app
 
+        # lr 0.05 (mom 0.9 → effective ~0.5) is marginal on this set:
+        # stable on the jax-0.9 jaxlib but collapses at step ~48 under
+        # 0.4.37's conv numerics (same trajectory on 1 and 8 devices, so
+        # not a comm artifact). 0.02×120 trains to top1=1.0 on both.
         out = app.main(
-            ["--data-dir", d, "--steps", "80", "--batch-size", "64",
-             "--lr", "0.05", "--log-every", "40", "--eval-batch", "64"]
+            ["--data-dir", d, "--steps", "120", "--batch-size", "64",
+             "--lr", "0.02", "--log-every", "40", "--eval-batch", "64"]
         )
         assert out["eval"]["top1"] > 0.9
 

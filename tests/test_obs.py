@@ -1,0 +1,430 @@
+"""Tests for mpit_tpu.obs — the unified runtime telemetry layer (ISSUE 1).
+
+Covers the tentpole's contract: span nesting/timing, the disabled-mode
+zero-allocation fast path (<1% loop overhead), Chrome-trace JSON schema
+validity, collective byte attribution on the fake 8-device CPU mesh, the
+parity-run traffic matrix (pserver row dominates), and the hardened_loop
+acceptance criterion (Perfetto-loadable timeline whose phase totals
+reconcile with wall time to within 5%).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mpit_tpu import obs
+from mpit_tpu.utils.profiling import StepTimer, collective_bytes
+
+
+@pytest.fixture(autouse=True)
+def _obs_disabled_by_default():
+    """Every test starts and ends with obs disabled (process-global)."""
+    obs.disable()
+    yield
+    obs.disable()
+
+
+class TestCore:
+    def test_span_records_timing(self):
+        rec = obs.enable(obs.Recorder())
+        with obs.span("work"):
+            time.sleep(0.02)
+        s = rec.summary()
+        assert s["phases"]["work"]["count"] == 1
+        assert s["phases"]["work"]["total_s"] >= 0.02
+        assert s["phases"]["work"]["p50_s"] <= s["phases"]["work"]["p95_s"]
+
+    def test_span_nesting_contained(self):
+        rec = obs.enable(obs.Recorder())
+        with obs.span("outer"):
+            time.sleep(0.005)
+            with obs.span("inner"):
+                time.sleep(0.005)
+            time.sleep(0.005)
+        evs = {
+            name: (t0, dur)
+            for kind, name, t0, dur, _tid, _a in rec.snapshot()["events"]
+            if kind == "X"
+        }
+        o0, od = evs["outer"]
+        i0, idur = evs["inner"]
+        assert o0 <= i0 and i0 + idur <= o0 + od  # inner ⊂ outer
+        assert od >= idur + 0.009  # outer also covers the flanking sleeps
+
+    def test_span_attrs_land_in_events(self):
+        rec = obs.enable(obs.Recorder())
+        with obs.span("phase", why="test", k=3):
+            pass
+        (attrs,) = [
+            a for kind, name, *_rest, a in rec.snapshot()["events"]
+            if name == "phase"
+        ]
+        assert attrs == {"why": "test", "k": 3}
+
+    def test_counters_accumulate_by_attrs(self):
+        rec = obs.enable(obs.Recorder())
+        obs.counter("bytes", 10, op="a")
+        obs.counter("bytes", 5, op="a")
+        obs.counter("bytes", 7, op="b")
+        items = {a["op"]: v for a, v in rec.counter_items("bytes")}
+        assert items == {"a": 15.0, "b": 7.0}
+        assert rec.counter_total("bytes") == 22.0
+
+    def test_gauge_keeps_last_value(self):
+        rec = obs.enable(obs.Recorder())
+        obs.gauge("lr", 0.1)
+        obs.gauge("lr", 0.01)
+        assert rec.snapshot()["gauges"][("lr", ())] == 0.01
+
+    def test_thread_safety_exact_totals(self):
+        rec = obs.enable(obs.Recorder())
+
+        def work():
+            for _ in range(1000):
+                obs.counter("hits", 1)
+                with obs.span("tick"):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert rec.counter_total("hits") == 8000.0
+        assert rec.summary()["phases"]["tick"]["count"] == 8000
+
+    def test_max_events_drops_counted(self):
+        rec = obs.enable(obs.Recorder(max_events=10))
+        for _ in range(20):
+            with obs.span("x"):
+                pass
+        s = rec.summary()
+        assert s["phases"]["x"]["count"] == 10
+        assert s["dropped_events"] == 10
+
+
+class TestDisabledFastPath:
+    def test_disabled_span_is_shared_noop(self):
+        # Zero-allocation contract: the same no-op object every call.
+        assert obs.span("a") is obs.span("b")
+
+    def test_disabled_primitives_record_nothing(self):
+        rec = obs.Recorder()  # NOT installed
+        with obs.span("x"):
+            pass
+        obs.counter("c", 1)
+        obs.gauge("g", 1.0)
+        obs.instant("i")
+        assert rec.snapshot()["events"] == []
+        assert not obs.enabled()
+        assert obs.summary() == {}
+
+    def test_disabled_overhead_under_one_percent_of_step(self, world8):
+        """Acceptance: obs-disabled instrumentation costs <1% of a CPU
+        -mesh training step. hardened_loop enters ≤4 spans per step
+        (prefetch_wait, step, host_fence, + one log/ckpt site); measure
+        the per-call disabled cost against a real measured step time."""
+        from mpit_tpu import opt as gopt
+        from mpit_tpu.train import make_train_step
+
+        n = 50_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with obs.span("hot"):
+                pass
+        per_call = (time.perf_counter() - t0) / n
+
+        init_fn, step_fn, _ = make_train_step(
+            _linear_loss, gopt.goo(0.1, 0.0), world8, zero1=False
+        )
+        state = init_fn(_linear_params())
+        batch = _shard_linear_batch(world8)
+        state, m = step_fn(state, batch)  # compile
+        float(m["loss"])
+        timer = StepTimer()
+        timer.start()
+        for _ in range(5):
+            state, m = step_fn(state, batch)
+            timer.tick(m["loss"])
+        step_s = timer.summary(skip_warmup=0)["mean_s"]
+        assert 4 * per_call < 0.01 * step_s, (
+            f"disabled obs costs {4 * per_call:.2e}s per step vs step "
+            f"time {step_s:.2e}s (>1%)"
+        )
+
+
+def _linear_params():
+    k = jax.random.key(0)
+    return {"w": jax.random.normal(k, (16, 16)) * 0.1}
+
+
+def _linear_loss(params, batch):
+    pred = batch["x"] @ params["w"]
+    return jnp.mean((pred - batch["y"]) ** 2), {}
+
+
+def _linear_batch(seed=0, rows=32):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(rows, 16)).astype(np.float32)
+    return {"x": x, "y": (x @ rng.normal(size=(16, 16))).astype(np.float32)}
+
+
+def _shard_linear_batch(world):
+    from mpit_tpu.data import shard_batch
+
+    return shard_batch(world, _linear_batch())
+
+
+class TestExport:
+    def _populate(self):
+        rec = obs.enable(obs.Recorder())
+        with obs.span("alpha", step=1):
+            with obs.span("beta"):
+                pass
+        obs.instant("marker", note="here")
+        obs.counter("collective_bytes", 1234.0, op="allreduce", axis="data")
+        return rec
+
+    def test_chrome_trace_schema(self, tmp_path):
+        rec = self._populate()
+        path = obs.export_chrome_trace(tmp_path / "trace_export.json", rec)
+        doc = json.load(open(path))
+        evs = doc["traceEvents"]
+        assert isinstance(evs, list) and evs
+        for ev in evs:
+            assert ev["ph"] in ("X", "i", "C", "M")
+            assert "name" in ev and "pid" in ev
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0 and ev["ts"] >= 0
+        names = {e["name"] for e in evs}
+        assert {"alpha", "beta", "marker", "thread_name"} <= names
+        # The counter series rides as a "C" event with its attrs label.
+        (c,) = [e for e in evs if e["ph"] == "C"]
+        assert c["args"]["value"] == 1234.0
+        assert "allreduce" in c["name"]
+
+    def test_jsonl_reuses_metric_record_shape(self, tmp_path):
+        rec = self._populate()
+        path = obs.export_jsonl(tmp_path / "obs.jsonl", rec)
+        records = [json.loads(l) for l in open(path)]
+        assert records
+        for r in records:
+            assert isinstance(r["step"], int)  # the MetricLogger shape
+        spans = [r for r in records if r.get("event") == "span"]
+        assert {s["name"] for s in spans} == {"alpha", "beta"}
+        (c,) = [r for r in records if r.get("event") == "counter"]
+        assert c["value"] == 1234.0 and c["op"] == "allreduce"
+
+    def test_export_requires_a_recorder(self, tmp_path):
+        with pytest.raises(RuntimeError, match="disabled"):
+            obs.export_chrome_trace(tmp_path / "t.json")
+
+
+class TestCollectiveAttribution:
+    """comm.collectives records modeled per-op wire bytes at trace time."""
+
+    def test_allreduce_bytes_on_8dev_mesh(self, world8):
+        from mpit_tpu.comm import collectives as C
+
+        rec = obs.enable(obs.Recorder())
+        x = jnp.ones((8, 1024), jnp.float32)
+        f = jax.jit(
+            world8.shard_map(
+                lambda v: C.allreduce(v, "data"),
+                in_specs=P("data"),
+                out_specs=P("data"),
+            )
+        )
+        np.testing.assert_allclose(np.asarray(f(x))[0], 8.0)
+        # Per-device payload: the (1, 1024) f32 shard = 4096 bytes.
+        want = collective_bytes(4096, 8, "allreduce")
+        items = {a["op"]: v for a, v in rec.counter_items("collective_bytes")}
+        assert items["allreduce"] == pytest.approx(want)
+        calls = {a["op"]: v for a, v in rec.counter_items("collective_calls")}
+        assert calls["allreduce"] == 1
+
+    def test_per_op_accumulation_and_axis_attr(self, world8):
+        from mpit_tpu.comm import collectives as C
+
+        rec = obs.enable(obs.Recorder())
+        x = jnp.ones((8, 256), jnp.float32)
+
+        def body(v):
+            g = C.allgather(v, "data")  # (8, 1, 256)
+            s = C.reduce_scatter(g.reshape(8, 256), "data")
+            return s
+
+        jax.jit(
+            world8.shard_map(body, in_specs=P("data"), out_specs=P("data"))
+        )(x).block_until_ready()
+        got = {
+            (a["op"], a["axis"]): v
+            for a, v in rec.counter_items("collective_bytes")
+        }
+        # allgather of the (1, 256) f32 shard; reduce_scatter of (8, 256).
+        assert got[("allgather", "data")] == pytest.approx(
+            collective_bytes(1024, 8, "all_gather")
+        )
+        assert got[("reduce_scatter", "data")] == pytest.approx(
+            collective_bytes(8 * 1024, 8, "reduce_scatter")
+        )
+
+    def test_disabled_records_nothing(self, world8):
+        from mpit_tpu.comm import collectives as C
+
+        x = jnp.ones((8, 16), jnp.float32)
+        jax.jit(
+            world8.shard_map(
+                lambda v: C.allreduce(v, "data"),
+                in_specs=P("data"),
+                out_specs=P("data"),
+            )
+        )(x).block_until_ready()
+        assert obs.get_recorder() is None
+
+
+class TestTrafficMatrix:
+    def test_parity_run_server_row_dominates(self):
+        """Downpour parity round: the rank×rank matrix shows the PS
+        traffic shape — the server row (params out) strictly dominates
+        every client row (grads in are a column, not a row)."""
+        import optax
+
+        from mpit_tpu.asyncsgd.actors import run_parameter_server
+
+        rec = obs.enable(obs.Recorder())
+        dim, rounds, nranks = 256, 3, 3
+
+        def client(cl, _idx):
+            for _ in range(rounds):
+                params = np.array(cl.fetch())
+                cl.push_grad(np.ones(dim, np.float32))
+            return params
+
+        run_parameter_server(
+            np.zeros(dim, np.float32),
+            optax.sgd(0.1),
+            client,
+            nranks=nranks,
+        )
+        m = obs.traffic_matrix(nranks, rec)
+        assert m.shape == (nranks, nranks)
+        server_row = m[0].sum()
+        for r in range(1, nranks):
+            assert server_row > m[r].sum()
+        # Params flow 0→r (dim f32 per fetch); grads flow r→0.
+        for r in range(1, nranks):
+            assert m[0, r] >= rounds * dim * 4
+            assert m[r, 0] >= rounds * dim * 4
+        # Receive-side accounting agrees with send-side totals.
+        mr = obs.traffic_matrix(nranks, rec, counter="p2p_recv_bytes")
+        np.testing.assert_allclose(mr, m)
+        # Protocol counters label the message kinds.
+        kinds = {
+            (a["role"], a["kind"]): v for a, v in rec.counter_items("ps_msgs")
+        }
+        assert kinds[("client", "fetch")] == rounds * (nranks - 1)
+        assert kinds[("client", "grad")] == rounds * (nranks - 1)
+
+
+class TestHardenedLoopTelemetry:
+    """The ISSUE 1 acceptance criterion, on the fake 8-device CPU mesh."""
+
+    def _run(self, world, tmp_path, *, steps=12):
+        from mpit_tpu import opt as gopt
+        from mpit_tpu.train import CheckpointManager, make_train_step
+        from mpit_tpu.train.loop import hardened_loop
+        from mpit_tpu.train.metrics import MetricLogger
+
+        init_fn, step_fn, state_specs = make_train_step(
+            _linear_loss, gopt.goo(0.05, 0.9), world, zero1=True
+        )
+        params = _linear_params()
+        state = init_fn(params)
+
+        def batches():
+            for i in range(steps + 4):
+                yield _linear_batch(seed=i)
+
+        eval_calls = []
+
+        def eval_hook(state):
+            eval_calls.append(1)
+            return {"probe": 1.0}
+
+        with CheckpointManager(tmp_path / "ck", world) as ckpt:
+            # The reconciliation target: StepTimer wall time around the
+            # loop itself (setup — jit of init_fn, checkpoint manager —
+            # is the caller's, not the loop's).
+            timer = StepTimer(block=False)
+            timer.start()
+            out = hardened_loop(
+                world,
+                state,
+                step_fn,
+                batches(),
+                steps=steps,
+                items_per_batch=32,
+                log_every=4,
+                logger=MetricLogger(stdout=False),
+                ckpt=ckpt,
+                ckpt_every=6,
+                specs=lambda: state_specs(params),
+                eval_every=6,
+                eval_hook=eval_hook,
+            )
+            wall = timer.tick()
+        assert eval_calls  # the eval span below really ran
+        return out, wall
+
+    def test_trace_phases_and_reconciliation(self, world8, tmp_path):
+        obs.enable(obs.Recorder())
+        out, wall = self._run(world8, tmp_path)
+
+        assert out["steps"] == 12
+        summ = out["obs"]
+        phases = summ["phases"]
+        for want in ("prefetch_wait", "step", "host_fence", "eval",
+                     "checkpoint_save"):
+            assert want in phases, f"missing phase {want}: {sorted(phases)}"
+        assert phases["step"]["count"] == 12
+        # Phase totals reconcile with the StepTimer wall clock: the loop
+        # spans are sequential (non-overlapping), so their sum must land
+        # within 5% of the end-to-end wall time of the run.
+        total = sum(p["total_s"] for p in phases.values())
+        assert total <= wall * 1.02  # spans cannot exceed the wall
+        assert total >= 0.95 * wall, (
+            f"phases cover {total:.3f}s of {wall:.3f}s wall "
+            f"({100 * total / wall:.1f}% < 95%): {phases}"
+        )
+        # The collective accounting rode along: the ZeRO-1 step traces
+        # reduce-scatter + all-gather on the data axis.
+        ops = {c["op"] for c in summ["collectives"]}
+        assert ops & {"reduce_scatter", "allgather", "pmean", "allreduce"}
+
+    def test_perfetto_loadable_trace(self, world8, tmp_path):
+        rec = obs.enable(obs.Recorder())
+        self._run(world8, tmp_path)[0]
+        path = obs.export_chrome_trace(tmp_path / "trace_export.json", rec)
+        doc = json.load(open(path))
+        evs = doc["traceEvents"]
+        names = {e["name"] for e in evs}
+        for want in ("prefetch_wait", "step", "host_fence", "eval",
+                     "checkpoint_save"):
+            assert want in names
+        # Spans are well-formed complete events on real threads.
+        for ev in evs:
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0 and isinstance(ev["tid"], int)
+
+    def test_loop_without_obs_attaches_nothing(self, world8, tmp_path):
+        out, _wall = self._run(world8, tmp_path)
+        assert "obs" not in out

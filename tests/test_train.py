@@ -288,12 +288,24 @@ class TestCheckpoint:
         del recorded["train_size"]
         meta_path.write_text(_json.dumps(recorded))
 
+        from jax.sharding import PartitionSpec as P
+
+        state_like = {"x": jnp.zeros(8)}
+        state_specs = jax.tree.map(lambda _: P(), state_like)
         with CheckpointManager(ckdir, world8, async_save=False) as m:
             # Default value for the new field: benign, no warning.
             with warnings.catch_warnings():
                 warnings.simplefilter("error")
                 m.ensure_meta(run_meta(cfg), defaults=defaults)
-        # The merge recorded it; strip again to test the non-default path.
+            # Deferred merge (round-5 advisor): validation alone must NOT
+            # widen the recorded meta — a failed/aborted resume would
+            # otherwise pin geometry the run never demonstrated.
+            assert "train_size" not in _json.loads(meta_path.read_text())
+            # A successful restore proves the run works: merge lands.
+            m.restore(state_like, state_specs)
+            rec = _json.loads(meta_path.read_text())
+            assert rec["train_size"] == defaults["train_size"]
+        # Strip again to test the non-default path.
         recorded = _json.loads(meta_path.read_text())
         del recorded["train_size"]
         meta_path.write_text(_json.dumps(recorded))
@@ -301,6 +313,15 @@ class TestCheckpoint:
             cfg16 = dataclasses.replace(cfg, train_size=16)
             with pytest.warns(UserWarning, match="train_size"):
                 m.ensure_meta(run_meta(cfg16), defaults=defaults)
+            # Run dies before restoring or saving: nothing pinned, so a
+            # corrected retry is not held hostage to the attempt.
+        assert "train_size" not in _json.loads(meta_path.read_text())
+        with CheckpointManager(ckdir, world8, async_save=False) as m:
+            with pytest.warns(UserWarning, match="train_size"):
+                m.ensure_meta(run_meta(cfg16), defaults=defaults)
+            m.save(2, state_like)  # first save flushes the pending merge
+            m.wait()
+        assert _json.loads(meta_path.read_text())["train_size"] == 16
         # And now it IS recorded (=16), so a later default run drifts.
         with CheckpointManager(ckdir, world8, async_save=False) as m:
             with pytest.raises(ValueError, match="train_size"):
