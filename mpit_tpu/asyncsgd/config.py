@@ -88,6 +88,23 @@ class TrainConfig:
     rrc_min_scale: float = 0.08  # min crop-area fraction for rrc
     max_restores: int = 1  # checkpoint restores after a diverged loss
     spike_factor: float = 0.0  # >0: treat loss > factor*EMA as divergence
+    # Host-path pipelining (ISSUE 2; train/loop.py + data/loader.py).
+    # Perf knobs, not trajectory geometry: deliberately NOT pinned by
+    # run_meta — a resume may change them freely.
+    fetch_lag: int = 2  # async metric-fetch window, fences (0 = sync)
+    # Host-stage threads in the prefetch pipeline. NOTE: parallelism
+    # applies to work the loop hands the host stage as a
+    # ``host_transform`` (hardened_loop kwarg); the asyncsgd datasets
+    # currently do their decode inside the stream iterator (serialized
+    # by the source lock), so >1 only helps callers that pass one —
+    # moving the datasets' decode/augment into host_transform is the
+    # follow-up that makes this knob bite for the imagenet path.
+    prefetch_workers: int = 1
+    prefetch_depth: int = 2  # staged device batches (floor)
+    # Adaptive ceiling: the pipeline grows its device buffer toward this
+    # while the loop observably starves on input (each unit = one staged
+    # device batch of HBM). Set equal to prefetch_depth to disable.
+    prefetch_max_depth: int = 8
     seed: int = 0
 
     def mesh_shape(self) -> dict[str, int] | None:

@@ -185,6 +185,10 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             spike_factor=cfg.spike_factor,
             profile_dir=cfg.profile_dir,
             final_save=True,
+            fetch_lag=cfg.fetch_lag,
+            prefetch_workers=cfg.prefetch_workers,
+            prefetch_depth=cfg.prefetch_depth,
+            prefetch_max_depth=cfg.prefetch_max_depth,
         )
         tier_info.update(
             preempted=result["preempted"], restores=result["restores"]

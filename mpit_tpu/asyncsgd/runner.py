@@ -411,6 +411,10 @@ def run_spmd(
         profile_dir=cfg.profile_dir,
         eval_every=cfg.eval_every if eval_hook else 0,
         eval_hook=eval_hook,
+        fetch_lag=cfg.fetch_lag,
+        prefetch_workers=cfg.prefetch_workers,
+        prefetch_depth=cfg.prefetch_depth,
+        prefetch_max_depth=cfg.prefetch_max_depth,
     )
     state = result["state"]
 

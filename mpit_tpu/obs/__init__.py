@@ -42,6 +42,7 @@ from mpit_tpu.obs.core import (
     disable,
     enable,
     enabled,
+    gap_attribution,
     gauge,
     get_recorder,
     instant,
@@ -62,6 +63,7 @@ __all__ = [
     "enabled",
     "export_chrome_trace",
     "export_jsonl",
+    "gap_attribution",
     "gauge",
     "get_recorder",
     "instant",

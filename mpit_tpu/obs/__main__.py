@@ -1,0 +1,134 @@
+"""``python -m mpit_tpu.obs`` — trace summary + app-path gap report.
+
+Reads an exported obs timeline — either the Chrome-trace JSON written by
+:func:`mpit_tpu.obs.export_chrome_trace` or the JSONL stream written by
+:func:`mpit_tpu.obs.export_jsonl` — rebuilds the phase roll-up offline,
+and prints the same summary/gap-attribution JSON the live recorder
+produces (ISSUE 2 satellite: the gap report without re-running the
+workload, for traces shipped off a pod).
+
+Usage::
+
+    python -m mpit_tpu.obs trace.json            # summary + gap report
+    python -m mpit_tpu.obs obs.jsonl --top 10    # widen the phase table
+    python -m mpit_tpu.obs trace.json --gap-only # just the attribution
+
+Exit status: 0 on success, 2 when the file holds no span events (a
+truncated or foreign trace — don't let an empty gap report read as "no
+overhead").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from mpit_tpu.obs.core import gap_attribution, phase_stats
+
+
+def _spans_from_chrome(doc: dict) -> tuple[dict, dict]:
+    """(name -> [dur_s]), (counter label -> value) from a Chrome trace."""
+    durs: dict[str, list[float]] = {}
+    counters: dict[str, float] = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "X":
+            durs.setdefault(ev["name"], []).append(
+                float(ev.get("dur", 0.0)) / 1e6
+            )
+        elif ev.get("ph") == "C":
+            counters[ev["name"]] = float(ev.get("args", {}).get("value", 0.0))
+    return durs, counters
+
+
+def _spans_from_jsonl(lines) -> tuple[dict, dict]:
+    """Same, from the MetricLogger-shaped JSONL export."""
+    durs: dict[str, list[float]] = {}
+    counters: dict[str, float] = {}
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("event") == "span":
+            durs.setdefault(rec["name"], []).append(float(rec["dur_s"]))
+        elif rec.get("event") == "counter":
+            counters[rec["name"]] = (
+                counters.get(rec["name"], 0.0) + float(rec["value"])
+            )
+    return durs, counters
+
+
+def _summarize(durs: dict) -> dict:
+    """The live recorder's roll-up (obs.core.phase_stats — one shared
+    definition), rounded for printing."""
+    rounding = {"total_s": 4, "p50_s": 6, "p95_s": 6}
+    return {
+        name: {
+            k: round(v, rounding[k]) if k in rounding else v
+            for k, v in stats.items()
+        }
+        for name, stats in phase_stats(durs).items()
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.obs",
+        description="Offline trace summary + app-path gap attribution.",
+    )
+    ap.add_argument(
+        "trace",
+        help="exported timeline: Chrome-trace .json or obs .jsonl",
+    )
+    ap.add_argument(
+        "--top", type=int, default=20, help="max phases to print (by total_s)"
+    )
+    ap.add_argument(
+        "--gap-only", action="store_true",
+        help="print only the gap-attribution block",
+    )
+    args = ap.parse_args(argv)
+
+    with open(args.trace) as f:
+        head = f.read(1)
+        f.seek(0)
+        if head == "{" and args.trace.endswith(".jsonl"):
+            durs, counters = _spans_from_jsonl(f)
+        elif head == "{":
+            # One JSON document => Chrome trace; a JSONL stream's first
+            # char is also "{", so fall back to line records on failure.
+            try:
+                durs, counters = _spans_from_chrome(json.load(f))
+            except json.JSONDecodeError:
+                f.seek(0)
+                durs, counters = _spans_from_jsonl(f)
+        else:
+            durs, counters = _spans_from_jsonl(f)
+
+    if not durs:
+        print(json.dumps({"error": "no span events found", "file": args.trace}))
+        return 2
+    phases = _summarize(durs)
+    gap = gap_attribution({"phases": phases})
+    if args.gap_only:
+        print(json.dumps({"gap_attribution": gap}, indent=1))
+        return 0
+    top = dict(
+        sorted(phases.items(), key=lambda kv: -kv[1]["total_s"])[: args.top]
+    )
+    out = {"phases": top, "gap_attribution": gap}
+    if counters:
+        out["counters"] = {
+            k: round(v, 1)
+            for k, v in sorted(counters.items(), key=lambda kv: -kv[1])[:10]
+        }
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

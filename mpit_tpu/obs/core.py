@@ -41,6 +41,7 @@ __all__ = [
     "disable",
     "enable",
     "enabled",
+    "gap_attribution",
     "gauge",
     "get_recorder",
     "instant",
@@ -54,6 +55,23 @@ def _attr_key(attrs: Mapping[str, Any] | None) -> tuple:
     if not attrs:
         return ()
     return tuple(sorted(attrs.items()))
+
+
+def phase_stats(durations: Mapping[str, Any]) -> dict:
+    """``{name: {count, total_s, p50_s, p95_s}}`` from per-phase
+    duration lists — the ONE definition of the phase roll-up, shared by
+    :meth:`Recorder.summary` (live) and ``python -m mpit_tpu.obs``
+    (offline traces), so the two reports cannot drift."""
+    phases = {}
+    for name, durs in sorted(durations.items()):
+        arr = np.asarray(durs)
+        phases[name] = {
+            "count": int(arr.size),
+            "total_s": float(arr.sum()),
+            "p50_s": float(np.percentile(arr, 50)),
+            "p95_s": float(np.percentile(arr, 95)),
+        }
+    return phases
 
 
 class Recorder:
@@ -157,28 +175,30 @@ class Recorder:
             self.dropped = 0
         return out
 
-    def summary(self, *, top_collectives: int = 5) -> dict:
+    def event_count(self) -> int:
+        """Current event-buffer length — a cursor for scoped summaries
+        (``summary(since=...)``): callers bracketing one sub-run of a
+        longer recording (bench's hardened-loop gap window) note the
+        count before and roll up only what landed after."""
+        with self._lock:
+            return len(self.events)
+
+    def summary(self, *, top_collectives: int = 5, since: int = 0) -> dict:
         """Roll events into ``{"phases": {name: {count, total_s, p50_s,
         p95_s}}, "collectives": [...], "counters": {...}}``.
 
         ``collectives`` lists the top-N ops by accumulated modeled wire
         bytes (the ``collective_bytes`` counter written by
-        ``comm.collectives``), most traffic first.
+        ``comm.collectives``), most traffic first. ``since`` restricts
+        the PHASE roll-up to events recorded at/after that buffer index
+        (see :meth:`event_count`); counters are cumulative either way.
         """
         snap = self.snapshot()
         by_name: dict[str, list[float]] = {}
-        for kind, name, _t0, dur, _tid, _attrs in snap["events"]:
+        for kind, name, _t0, dur, _tid, _attrs in snap["events"][since:]:
             if kind == "X":
                 by_name.setdefault(name, []).append(dur)
-        phases = {}
-        for name, durs in sorted(by_name.items()):
-            arr = np.asarray(durs)
-            phases[name] = {
-                "count": int(arr.size),
-                "total_s": float(arr.sum()),
-                "p50_s": float(np.percentile(arr, 50)),
-                "p95_s": float(np.percentile(arr, 95)),
-            }
+        phases = phase_stats(by_name)
         colls = [
             ({**dict(k[1])}, v)
             for k, v in snap["counters"].items()
@@ -307,9 +327,70 @@ def gauge(name: str, value: float, **attrs) -> None:
         rec.add_gauge(name, value, attrs or None)
 
 
-def summary(*, top_collectives: int = 5) -> dict:
+def summary(*, top_collectives: int = 5, since: int = 0) -> dict:
     """Summary of the installed recorder ({} when disabled)."""
     rec = _RECORDER
     if rec is None:
         return {}
-    return rec.summary(top_collectives=top_collectives)
+    return rec.summary(top_collectives=top_collectives, since=since)
+
+
+# Loop phases that are host-side wall clock AROUND device dispatch — the
+# app-path components `hardened_loop` spans (train/loop.py). "step" is
+# the dispatch+compute span itself; everything else is the candidate
+# overhead the async pipeline exists to overlap away. The prefetch
+# pipeline's own stages run on their OWN threads (they overlap the loop)
+# and are reported separately.
+_HOST_PHASES = (
+    "prefetch_wait",
+    "host_fence",
+    "checkpoint_save",
+    "eval",
+    "divergence_restore",
+)
+_OVERLAPPED_PHASES = ("prefetch_host", "prefetch_device_put")
+
+
+def gap_attribution(summ: Mapping | None = None) -> dict:
+    """Attribute a training run's app-path wall clock across loop phases.
+
+    Input: a :func:`summary`-shaped dict (default: the installed
+    recorder's). Output rolls the ``hardened_loop`` span phases into the
+    app-path gap report (ISSUE 2): the loop-thread wall split into
+    ``step`` (host dispatch + device wait inside the step span) vs each
+    host phase, plus each phase's share of the loop total.
+
+    Interpretation note for the async host path: once the metric fences
+    are pipelined, a large ``host_fence`` share means the host is parked
+    *waiting for the device to catch up* — overlap working as intended —
+    while a large ``prefetch_wait`` share means input starvation. The
+    throughput-derived ``app_path_overhead_pct`` (bench.py) is the
+    verdict; this roll-up is the attribution of where the wall went.
+    ``prefetch_host`` / ``prefetch_device_put`` run on pipeline threads
+    (they overlap the loop) and are reported for context, not summed
+    into the loop wall.
+    """
+    if summ is None:
+        summ = summary()
+    phases = summ.get("phases", {}) if summ else {}
+    step_s = phases.get("step", {}).get("total_s", 0.0)
+    host = {
+        n: phases[n]["total_s"] for n in _HOST_PHASES if n in phases
+    }
+    overlap = {
+        n: round(phases[n]["total_s"], 4)
+        for n in _OVERLAPPED_PHASES
+        if n in phases
+    }
+    host_s = sum(host.values())
+    loop_s = step_s + host_s
+    out = {
+        "loop_s": round(loop_s, 4),
+        "step_s": round(step_s, 4),
+        "host_s": round(host_s, 4),
+        "host_phases_s": {n: round(v, 4) for n, v in sorted(host.items())},
+        "host_share_pct": round(100.0 * host_s / loop_s, 2) if loop_s else 0.0,
+    }
+    if overlap:
+        out["overlapped_s"] = overlap
+    return out

@@ -40,6 +40,8 @@ class CheckpointManager:
         self._world = world
         self._dir = Path(directory).absolute()
         self._pending_meta: dict | None = None
+        self._async_save = async_save
+        self._meta_flush_on_wait = False
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
@@ -172,8 +174,15 @@ class CheckpointManager:
     def save(self, step: int, state: Any) -> None:
         self._mgr.save(step, args=ocp.args.StandardSave(state))
         # AFTER the save is accepted: a first save that raises must not
-        # pin attempted-only geometry (same rule as restore()).
-        self._flush_pending_meta()
+        # pin attempted-only geometry (same rule as restore()). An ASYNC
+        # save has only been staged here — its background write can still
+        # fail (disk full, preemption), surfacing at wait() — so the
+        # flush waits for durability before pinning; a synchronous save
+        # is already durable (round-6 review finding).
+        if self._async_save:
+            self._meta_flush_on_wait = True
+        else:
+            self._flush_pending_meta()
 
     def restore(self, state_like: Any, specs: Any, *, step: int | None = None):
         """Restore the checkpoint at ``step`` (default: latest).
@@ -213,6 +222,11 @@ class CheckpointManager:
     def wait(self) -> None:
         """Block until pending async saves are durable."""
         self._mgr.wait_until_finished()
+        if getattr(self, "_meta_flush_on_wait", False):
+            # The staged save(s) are now durable: the deferred
+            # ensure_meta merge may pin (see save()).
+            self._meta_flush_on_wait = False
+            self._flush_pending_meta()
 
     def close(self) -> None:
         self._mgr.close()

@@ -9,6 +9,16 @@ checkpoint, and continue.
 
 Detection is deliberately cheap: checks ride the existing log-point host
 fetch; no extra device syncs are inserted into the hot loop.
+
+Lagged detection (ISSUE 2): with the async metric pipeline the loop no
+longer blocks on ``float(loss)`` at the fence where a step ran — it
+starts a host copy and consumes the value up to ``lag`` fences later.
+The guard therefore accepts a *lag window*: ``check`` takes the step the
+loss belongs to plus the (later) step the loop had reached when the
+value arrived, and the raised :class:`Diverged` carries both. The
+restore POLICY is unchanged — a failing loss still restores the newest
+checkpoint older than the previous restore target — only the detection
+point moves, by at most ``lag`` fence intervals.
 """
 
 from __future__ import annotations
@@ -17,15 +27,30 @@ import math
 
 
 class Diverged(RuntimeError):
-    """Training produced a non-finite or exploding loss."""
+    """Training produced a non-finite or exploding loss.
 
-    def __init__(self, step: int, loss: float, reason: str):
+    ``step`` is the step whose loss failed; ``detected_step`` is where
+    the loop's host side had advanced to when the (possibly async)
+    fetch delivered the value — equal to ``step`` for synchronous
+    detection, up to ``lag`` fences later for the pipelined path.
+    """
+
+    def __init__(
+        self, step: int, loss: float, reason: str,
+        detected_step: int | None = None,
+    ):
+        detected_step = step if detected_step is None else detected_step
+        late = (
+            f", detected at step {detected_step}"
+            if detected_step != step else ""
+        )
         super().__init__(
-            f"training diverged at step {step}: loss={loss} ({reason})"
+            f"training diverged at step {step}: loss={loss} ({reason}{late})"
         )
         self.step = step
         self.loss = loss
         self.reason = reason
+        self.detected_step = detected_step
 
 
 class DivergenceGuard:
@@ -34,19 +59,40 @@ class DivergenceGuard:
     - non-finite loss: always fatal (raises :class:`Diverged`);
     - spike detection (opt-in via ``spike_factor > 0``): raises when the
       loss exceeds ``spike_factor ×`` its EMA, after ``warmup`` healthy
-      checks (early-training noise is not a spike).
+      checks (early-training noise is not a spike);
+    - lag window (``lag ≥ 0``, ISSUE 2): the loop may deliver the loss
+      of step N while its host side is already at step N + lag·fence.
+      ``check`` accepts the delivery point as ``detected_step`` and
+      enforces that the delay never exceeds the declared window — a
+      pipeline that silently grows its backlog would otherwise turn
+      "detection delayed ≤ k" into "detection delayed unboundedly".
     """
 
-    def __init__(self, *, spike_factor: float = 0.0, ema: float = 0.9, warmup: int = 5):
+    def __init__(
+        self, *, spike_factor: float = 0.0, ema: float = 0.9,
+        warmup: int = 5, lag: int = 0, fence: int = 1,
+    ):
         self.spike_factor = spike_factor
+        self.lag = lag
+        self.fence = max(1, fence)
         self._ema_coef = ema
         self._warmup = warmup
         self._ema: float | None = None
         self._window: list[float] = []
 
-    def check(self, step: int, loss: float) -> None:
+    def check(
+        self, step: int, loss: float, *, detected_step: int | None = None
+    ) -> None:
+        detected = step if detected_step is None else detected_step
+        if detected - step > self.lag * self.fence:
+            raise RuntimeError(
+                f"DivergenceGuard: loss for step {step} delivered at step "
+                f"{detected}, past the declared lag window "
+                f"({self.lag} fences x {self.fence} steps) — the async "
+                "metric pipeline is not bounding its backlog"
+            )
         if not math.isfinite(loss):
-            raise Diverged(step, loss, "non-finite")
+            raise Diverged(step, loss, "non-finite", detected_step=detected)
         if len(self._window) < self._warmup:
             # Warmup: tolerate transients AND keep them out of the
             # baseline — the EMA seeds from the warmup *median*, so one
@@ -58,7 +104,9 @@ class DivergenceGuard:
         assert self._ema is not None
         if self.spike_factor > 0 and loss > self.spike_factor * self._ema:
             raise Diverged(
-                step, loss, f"spike > {self.spike_factor}x EMA {self._ema:.4g}"
+                step, loss,
+                f"spike > {self.spike_factor}x EMA {self._ema:.4g}",
+                detected_step=detected,
             )
         self._ema = self._ema_coef * self._ema + (1 - self._ema_coef) * loss
 

@@ -76,7 +76,43 @@ def compile_shape(world, t, h, d, group=None):
         fa._GROUP_OVERRIDE = prev
 
 
+def _probe_topology(topology: str, timeout_s: float = 90.0) -> str | None:
+    """Preflight in a throwaway subprocess: ``get_topology_desc`` can
+    HANG inside native PJRT code (holding the GIL) when the TPU plugin's
+    transport is dead, so an in-process attempt can never time out
+    (same pattern as ``tests/test_ops.py::TestFlashVmemSweepSubset``).
+    Returns None when the compiler is reachable, else a reason string —
+    recorded in FLASH_VMEM_SWEEP.json so a blocked run leaves an honest
+    artifact instead of an infinite hang and nothing."""
+    import subprocess
+
+    probe = (
+        "from jax.experimental import topologies;"
+        f"topologies.get_topology_desc({topology!r}, platform='tpu')"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", probe],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return f"topology lookup hung >{timeout_s:.0f}s (dead TPU tunnel?)"
+    if r.returncode != 0:
+        return "no TPU PJRT plugin: " + r.stderr.strip()[-200:]
+    return None
+
+
 def main(topology: str = "v5e:2x4") -> int:
+    blocked = _probe_topology(topology)
+    if blocked is not None:
+        summary = {"status": "compiler_unreachable", "reason": blocked,
+                   "topology": topology, "shapes": 0}
+        with open("FLASH_VMEM_SWEEP.json", "w") as f:
+            json.dump({"summary": summary, "results": []}, f, indent=1)
+        print(json.dumps(summary))
+        return 1
     world = topology_world({"data": 8}, topology)
     results = []
     bad_unsafe, bad_conservative = [], []

@@ -33,11 +33,27 @@ def _realistic_results():
             {"op": "allreduce", "axis": "data", "wire_bytes": 1024.0},
         ],
     }
+    gap_attribution = {
+        "loop_s": 12.3456,
+        "step_s": 11.9876,
+        "host_s": 0.358,
+        "host_phases_s": {
+            "prefetch_wait": 0.1234,
+            "host_fence": 0.2103,
+            "checkpoint_save": 0.0123,
+            "eval": 0.012,
+        },
+        "host_share_pct": 2.9,
+        "overlapped_s": {"prefetch_device_put": 0.1219},
+    }
     return {
         "alexnet": {
             "images_per_sec": 123456.78,
             "ms_per_step": 123.45,
             "app_path_images_per_sec": 123456.78,
+            "app_path_overhead_pct": -12.34,
+            "hardened_items_per_sec": 123456.78,
+            "gap_attribution": gap_attribution,
             "global_batch": 2048,
             "batch_per_device": 2048,
             "steps": 8,
@@ -61,6 +77,9 @@ def _realistic_results():
         "gpt2": {
             "tokens_per_sec": 130301.5,
             "app_path_tokens_per_sec": 127003.1,
+            "app_path_overhead_pct": -12.34,
+            "hardened_items_per_sec": 127003.1,
+            "gap_attribution": gap_attribution,
             "ms_per_step": 188.62,
             "batch": 48,
             "seq_len": 512,
@@ -122,13 +141,20 @@ class TestLineBudget:
         assert rec["vs_baseline"] == round(123456.78 / 18007.75, 3)
         assert rec["detail"]["gpt2"]["vs_r1"] == round(130301.5 / 66687.0, 3)
         assert rec["detail_file"] == "BENCH_DETAIL.json"
+        # The app-path gap is a first-class record metric (ISSUE 2): the
+        # driver line must carry it for both cross-checked workloads.
+        assert rec["detail"]["alexnet"]["app_path_overhead_pct"] == -12.34
+        assert rec["detail"]["gpt2"]["app_path_overhead_pct"] == -12.34
         # Bulky blobs must NOT ride the line.
         assert "scaling" not in rec["detail"]["alexnet"]
         assert "drop_rate_per_moe_layer" not in rec["detail"]["gpt2_moe"]
-        # The obs phase breakdown is detail-file-only too (ISSUE 1).
+        # The obs phase breakdown is detail-file-only too (ISSUE 1), and
+        # so is the gap ATTRIBUTION (the line carries only the pct).
         for wl in rec["detail"].values():
             if isinstance(wl, dict):
                 assert "phases" not in wl
+                assert "gap_attribution" not in wl
+                assert "hardened_items_per_sec" not in wl
 
     def test_partial_record_parses(self):
         # Progressive emission: record printed after the headline only,

@@ -40,6 +40,223 @@ class TestDivergenceGuard:
         g.check(2, 100.0)  # fresh history: no spike baseline
 
 
+class TestGuardLagWindow:
+    """ISSUE 2: the guard accepts delayed (async-pipeline) delivery."""
+
+    def test_delayed_delivery_within_window(self):
+        g = DivergenceGuard(lag=2, fence=4)
+        g.check(4, 1.0, detected_step=12)  # 8 = 2 fences late: fine
+        with pytest.raises(Diverged) as ei:
+            g.check(8, float("nan"), detected_step=16)
+        assert ei.value.step == 8
+        assert ei.value.detected_step == 16
+        assert "detected at step 16" in str(ei.value)
+
+    def test_delivery_past_window_is_a_pipeline_bug(self):
+        g = DivergenceGuard(lag=2, fence=4)
+        with pytest.raises(RuntimeError, match="lag window"):
+            g.check(4, 1.0, detected_step=13)  # 9 > 2 fences x 4 steps
+
+    def test_sync_default_keeps_zero_window(self):
+        g = DivergenceGuard()  # lag=0: synchronous contract unchanged
+        g.check(3, 1.0)
+        with pytest.raises(RuntimeError, match="lag window"):
+            g.check(3, 1.0, detected_step=4)
+
+    def test_spike_carries_detection_point(self):
+        g = DivergenceGuard(spike_factor=5.0, warmup=1, lag=1, fence=10)
+        g.check(1, 1.0)
+        g.check(2, 1.0)
+        with pytest.raises(Diverged) as ei:
+            g.check(10, 50.0, detected_step=20)
+        assert ei.value.detected_step == 20
+
+
+class TestAsyncFencePipeline:
+    """ISSUE 2 tentpole: hardened_loop's async metric fetch — identical
+    trajectories, delayed-but-bounded divergence detection, and the
+    never-save-on-a-failing-loss invariant under lag."""
+
+    def _loop(self, world, tmp_path, *, fetch_lag, poison=None, steps=20,
+              log_every=3, ckpt_every=5, jsonl=None, max_restores=1,
+              dispatch_fence=0):
+        from mpit_tpu import opt as gopt
+        from mpit_tpu.train import CheckpointManager, make_train_step
+        from mpit_tpu.train.loop import hardened_loop
+        from mpit_tpu.train.metrics import MetricLogger
+
+        def loss_fn(params, batch):
+            pred = batch["x"] @ params["w"]
+            return jnp.mean((pred - batch["y"]) ** 2), {}
+
+        init_fn, step_fn, state_specs = make_train_step(
+            loss_fn, gopt.goo(0.05, 0.9), world, zero1=True
+        )
+        k = jax.random.key(0)
+        params = {"w": jax.random.normal(k, (16, 16)) * 0.1}
+        state = init_fn(params)
+
+        def batches():
+            rng = np.random.default_rng(7)
+            for i in range(steps + 8):
+                x = rng.normal(size=(32, 16)).astype(np.float32)
+                if i == poison:
+                    x = np.full_like(x, np.nan)
+                yield {"x": x, "y": (x * 0.5).astype(np.float32)}
+
+        with CheckpointManager(tmp_path / f"ck{fetch_lag}", world) as ckpt:
+            out = hardened_loop(
+                world,
+                state,
+                step_fn,
+                batches(),
+                steps=steps,
+                items_per_batch=32,
+                log_every=log_every,
+                logger=MetricLogger(jsonl, stdout=False),
+                ckpt=ckpt,
+                ckpt_every=ckpt_every,
+                specs=lambda: state_specs(params),
+                max_restores=max_restores,
+                dispatch_fence=dispatch_fence,
+                fetch_lag=fetch_lag,
+            )
+            saved = ckpt.all_steps()
+        return out, saved
+
+    def test_async_matches_sync_trajectory(self, world8, tmp_path):
+        sync, _ = self._loop(world8, tmp_path / "s", fetch_lag=0)
+        async_, _ = self._loop(world8, tmp_path / "a", fetch_lag=2)
+        assert sync["steps"] == async_["steps"] == 20
+        # The pipeline changes WHEN losses are fetched, never their
+        # values or which steps get logged.
+        np.testing.assert_allclose(sync["losses"], async_["losses"])
+
+    def test_sparse_logs_still_fence_dispatch(self, world8, tmp_path):
+        """With log points rarer than dispatch_fence, the async path
+        must still fetch SOMETHING at fence cadence — the watermark rule
+        (round-6 review): unfetched dispatch depth stays bounded by
+        dispatch_fence plus one fence interval, it does not balloon to
+        2x between sparse fences."""
+        from mpit_tpu import obs
+
+        rec = obs.enable(obs.Recorder())
+        try:
+            out, _ = self._loop(
+                world8, tmp_path, fetch_lag=2, steps=30,
+                log_every=100, ckpt_every=0, dispatch_fence=8,
+            )
+        finally:
+            obs.disable()
+        assert out["steps"] == 30
+        fences = [
+            a for kind, name, _t0, _dur, _tid, a in rec.snapshot()["events"]
+            if kind == "X" and name == "host_fence"
+        ]
+        # Fence pushes land at steps 8/16/24 and each must be consumed
+        # within one fence interval of its push (lag attr ≤ 8), keeping
+        # the watermark within dispatch_fence of the host step.
+        waits = [a for a in fences if a and a.get("why") == "fence"]
+        assert len(waits) >= 3, fences
+        assert all(a.get("lag", 0) <= 8 for a in waits), waits
+
+    def test_lagged_detection_restores_and_completes(self, world8, tmp_path):
+        import json as _json
+
+        jsonl = tmp_path / "m.jsonl"
+        # Poisoned batch 8 -> NaN loss at fence step 9 (a log point, not
+        # a save point) -> pushed async, consumed by the step-10 save
+        # drain: detection is one step late, restore lands on ckpt 5.
+        out, saved = self._loop(
+            world8, tmp_path, fetch_lag=2, poison=8, jsonl=jsonl
+        )
+        assert out["restores"] == 1
+        assert out["steps"] == 20
+        assert np.isfinite(out["final_loss"])
+        recs = [_json.loads(l) for l in jsonl.read_text().splitlines()]
+        (restore,) = [
+            r for r in recs if r.get("event") == "restored_after_divergence"
+        ]
+        assert restore["diverged_step"] == 9
+        assert restore["detected_step"] == 10
+        assert restore["step"] == 5  # the restored-to checkpoint
+
+    def test_preempt_drain_checks_inflight_losses(self, world8, tmp_path):
+        """SIGTERM while a NaN loss sits in the async pipeline: the
+        preempt drain must guard-check it (round-6 review) — the drain
+        checkpoint lands on the RESTORED trajectory, never the poisoned
+        one. SIGTERM is raised from inside the poisoned step's dispatch,
+        so the very next loop iteration enters the preempt branch with
+        the NaN fence still pending."""
+        import os
+        import signal as _signal
+
+        from mpit_tpu import opt as gopt
+        from mpit_tpu.train import CheckpointManager, make_train_step
+        from mpit_tpu.train.loop import hardened_loop
+        from mpit_tpu.train.metrics import MetricLogger
+
+        def loss_fn(params, batch):
+            pred = batch["x"] @ params["w"]
+            return jnp.mean((pred - batch["y"]) ** 2), {}
+
+        init_fn, step_fn, state_specs = make_train_step(
+            loss_fn, gopt.goo(0.05, 0.9), world8, zero1=True
+        )
+        params = {"w": jax.random.normal(jax.random.key(0), (16, 16)) * 0.1}
+        calls = {"n": 0}
+
+        def step_with_sigterm(state, batch):
+            # Call index 8 executes the poisoned batch; firing here puts
+            # the SIGTERM before the next iteration's preempt check,
+            # while the (NaN) fence of step 9 is still in the pipeline.
+            if calls["n"] == 8:
+                os.kill(os.getpid(), _signal.SIGTERM)
+            calls["n"] += 1
+            return step_fn(state, batch)
+
+        def batches():
+            rng = np.random.default_rng(7)
+            for i in range(24):
+                x = rng.normal(size=(32, 16)).astype(np.float32)
+                if i == 8:
+                    x = np.full_like(x, np.nan)
+                yield {"x": x, "y": (x * 0.5).astype(np.float32)}
+
+        with CheckpointManager(tmp_path / "ck", world8) as ckpt:
+            out = hardened_loop(
+                world8, init_fn(params), step_with_sigterm, batches(),
+                steps=20, log_every=3, logger=MetricLogger(stdout=False),
+                ckpt=ckpt, ckpt_every=5,
+                specs=lambda: state_specs(params),
+                max_restores=1, dispatch_fence=0, fetch_lag=2,
+            )
+            saved = ckpt.all_steps()
+        assert out["preempted"] is True
+        assert out["restores"] == 1  # the pending NaN was checked
+        assert out["steps"] == 5  # drained at the restored step
+        assert saved == [5], saved  # no checkpoint on the poisoned path
+        for leaf in jax.tree.leaves(out["state"].params):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_no_checkpoint_saved_on_failing_loss(self, world8, tmp_path):
+        """The step-10 save point drains the pipeline FIRST: the NaN at
+        step 9 must fire before ckpt.save(10). With no restore budget
+        the run dies right there — the newest checkpoint on disk must
+        predate the poisoned step (a post-async-pipeline save of step 10
+        would have shipped a possibly-poisoned state)."""
+        from mpit_tpu.train import Diverged as Dvg
+
+        with pytest.raises(Dvg):
+            self._loop(
+                world8, tmp_path, fetch_lag=2, poison=8, max_restores=0
+            )
+        from mpit_tpu.train import CheckpointManager
+
+        with CheckpointManager(tmp_path / "ck2", world8) as ckpt:
+            assert ckpt.all_steps() == [5]
+
+
 class TestRecoveryIntegration:
     def _run(self, tmp_path, poison_step, max_restores):
         """MNIST-shaped run whose stream yields one NaN-poisoned batch."""

@@ -335,6 +335,103 @@ class TestTrafficMatrix:
         assert kinds[("client", "grad")] == rounds * (nranks - 1)
 
 
+class TestGapAttribution:
+    """ISSUE 2: the app-path gap roll-up over summary() phases."""
+
+    def _summary(self):
+        return {
+            "phases": {
+                "step": {"count": 24, "total_s": 9.0},
+                "host_fence": {"count": 8, "total_s": 0.6},
+                "prefetch_wait": {"count": 24, "total_s": 0.3},
+                "checkpoint_save": {"count": 2, "total_s": 0.1},
+                "prefetch_device_put": {"count": 24, "total_s": 2.0},
+                "workload": {"count": 1, "total_s": 99.0},  # not a loop phase
+            }
+        }
+
+    def test_rollup_shape_and_shares(self):
+        gap = obs.gap_attribution(self._summary())
+        assert gap["step_s"] == 9.0
+        assert gap["host_s"] == pytest.approx(1.0)
+        assert gap["loop_s"] == pytest.approx(10.0)
+        assert gap["host_share_pct"] == pytest.approx(10.0)
+        assert gap["host_phases_s"] == {
+            "checkpoint_save": 0.1, "host_fence": 0.6, "prefetch_wait": 0.3,
+        }
+        # Pipeline-thread phases overlap the loop: reported, not summed.
+        assert gap["overlapped_s"] == {"prefetch_device_put": 2.0}
+        assert "workload" not in gap["host_phases_s"]
+
+    def test_empty_and_disabled(self):
+        assert obs.gap_attribution({})["loop_s"] == 0.0
+        assert obs.gap_attribution()["host_share_pct"] == 0.0  # disabled
+
+    def test_live_recorder_and_scoped_summary(self):
+        rec = obs.enable(obs.Recorder())
+        with obs.span("step"):
+            time.sleep(0.01)
+        n0 = rec.event_count()
+        with obs.span("step"):
+            time.sleep(0.01)
+        with obs.span("host_fence", why="log"):
+            time.sleep(0.002)
+        scoped = rec.summary(since=n0)
+        assert scoped["phases"]["step"]["count"] == 1  # first span excluded
+        gap = obs.gap_attribution(scoped)
+        assert gap["host_s"] > 0 and gap["step_s"] > 0
+        assert 0 < gap["host_share_pct"] < 100
+
+
+class TestTraceSummaryCLI:
+    """python -m mpit_tpu.obs — the offline trace-summary entry point."""
+
+    def _trace(self, tmp_path):
+        rec = obs.enable(obs.Recorder())
+        with obs.span("step"):
+            time.sleep(0.005)
+        with obs.span("host_fence", why="log", lag=2):
+            time.sleep(0.002)
+        obs.counter("collective_bytes", 512.0, op="allreduce")
+        return obs.export_chrome_trace(tmp_path / "t.json", rec), rec
+
+    def _run_cli(self, *argv):
+        import subprocess
+        import sys
+
+        return subprocess.run(
+            [sys.executable, "-m", "mpit_tpu.obs", *argv],
+            capture_output=True, text=True, timeout=120,
+        )
+
+    def test_chrome_trace_summary(self, tmp_path):
+        path, rec = self._trace(tmp_path)
+        out = self._run_cli(str(path))
+        assert out.returncode == 0, out.stderr[-2000:]
+        doc = json.loads(out.stdout)
+        assert doc["phases"]["step"]["count"] == 1
+        assert doc["phases"]["host_fence"]["total_s"] > 0
+        gap = doc["gap_attribution"]
+        assert gap["step_s"] > 0 and gap["host_s"] > 0
+        assert any("allreduce" in k for k in doc["counters"])
+
+    def test_jsonl_summary_and_gap_only(self, tmp_path):
+        _, rec = self._trace(tmp_path)
+        path = obs.export_jsonl(tmp_path / "o.jsonl", rec)
+        out = self._run_cli(str(path), "--gap-only")
+        assert out.returncode == 0, out.stderr[-2000:]
+        doc = json.loads(out.stdout)
+        assert set(doc) == {"gap_attribution"}
+        assert doc["gap_attribution"]["loop_s"] > 0
+
+    def test_spanless_file_exits_nonzero(self, tmp_path):
+        p = tmp_path / "empty.json"
+        p.write_text(json.dumps({"traceEvents": []}))
+        out = self._run_cli(str(p))
+        assert out.returncode == 2
+        assert "no span events" in out.stdout
+
+
 class TestHardenedLoopTelemetry:
     """The ISSUE 1 acceptance criterion, on the fake 8-device CPU mesh."""
 
@@ -396,10 +493,18 @@ class TestHardenedLoopTelemetry:
                      "checkpoint_save"):
             assert want in phases, f"missing phase {want}: {sorted(phases)}"
         assert phases["step"]["count"] == 12
-        # Phase totals reconcile with the StepTimer wall clock: the loop
-        # spans are sequential (non-overlapping), so their sum must land
-        # within 5% of the end-to-end wall time of the run.
-        total = sum(p["total_s"] for p in phases.values())
+        # Phase totals reconcile with the StepTimer wall clock: the
+        # LOOP-THREAD spans are sequential (non-overlapping), so their
+        # sum must land within 5% of the end-to-end wall time of the
+        # run. The prefetch pipeline's own stages (ISSUE 2) run on
+        # their own threads and OVERLAP the loop — they are excluded
+        # here exactly as obs.gap_attribution classifies them.
+        from mpit_tpu.obs.core import _OVERLAPPED_PHASES
+
+        total = sum(
+            p["total_s"] for name, p in phases.items()
+            if name not in _OVERLAPPED_PHASES
+        )
         assert total <= wall * 1.02  # spans cannot exceed the wall
         assert total >= 0.95 * wall, (
             f"phases cover {total:.3f}s of {wall:.3f}s wall "

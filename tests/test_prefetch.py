@@ -1,0 +1,208 @@
+"""Prefetcher pipeline edge cases (ISSUE 2 tentpole + satellite).
+
+The two-stage Prefetcher (multi-thread host stage → single ordered
+``device_put`` stage, ``data/loader.py``) must preserve every semantic
+the single-thread version had: iteration order, in-order exception
+surfacing, clean close (including under a blocked consumer), depth=1,
+and exhaustion ordering — at every ``host_workers`` setting.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpit_tpu.data import Prefetcher
+
+
+WORKERS = [1, 4]
+
+
+def _batches(n, rows=8):
+    for i in range(n):
+        yield {"x": np.full((rows, 1), float(i), np.float32)}
+
+
+def _values(batches):
+    return [float(np.asarray(b["x"])[0, 0]) for b in batches]
+
+
+class TestOrdering:
+    @pytest.mark.parametrize("workers", WORKERS)
+    def test_order_preserved(self, world8, workers):
+        with Prefetcher(
+            world8, _batches(12), depth=3, host_workers=workers
+        ) as pf:
+            assert _values(pf) == [float(i) for i in range(12)]
+
+    @pytest.mark.parametrize("workers", WORKERS)
+    def test_order_with_jittery_host_transform(self, world8, workers):
+        """Workers finishing out of order must not reorder delivery —
+        the reorder buffer, not thread luck, owns sequencing."""
+        rng_lock = threading.Lock()
+        rng = np.random.default_rng(0)
+
+        def jitter(b):
+            with rng_lock:
+                d = float(rng.uniform(0, 0.01))
+            time.sleep(d)
+            return b
+
+        with Prefetcher(
+            world8, _batches(16), depth=2,
+            host_workers=workers, host_transform=jitter,
+        ) as pf:
+            assert _values(pf) == [float(i) for i in range(16)]
+
+    @pytest.mark.parametrize("workers", WORKERS)
+    def test_exhaustion_ordering(self, world8, workers):
+        """Iterator exhaustion: every yielded batch arrives, in order,
+        THEN StopIteration — and keeps raising StopIteration after."""
+        pf = Prefetcher(world8, _batches(5), host_workers=workers)
+        got = _values(pf)
+        assert got == [0.0, 1.0, 2.0, 3.0, 4.0]
+        for _ in range(3):  # iterator contract: stays exhausted
+            with pytest.raises(StopIteration):
+                next(pf)
+        pf.close()
+
+    @pytest.mark.parametrize("workers", WORKERS)
+    def test_depth_one(self, world8, workers):
+        with Prefetcher(
+            world8, _batches(6), depth=1, host_workers=workers,
+            adaptive=False,
+        ) as pf:
+            assert _values(pf) == [float(i) for i in range(6)]
+
+
+class TestExceptions:
+    @pytest.mark.parametrize("workers", WORKERS)
+    def test_source_raises_mid_stream(self, world8, workers):
+        def gen():
+            yield {"x": np.zeros((8, 1), np.float32)}
+            yield {"x": np.ones((8, 1), np.float32)}
+            raise RuntimeError("boom")
+
+        with Prefetcher(world8, gen(), host_workers=workers) as pf:
+            assert _values([next(pf), next(pf)]) == [0.0, 1.0]
+            with pytest.raises(RuntimeError, match="boom"):
+                next(pf)
+
+    @pytest.mark.parametrize("workers", WORKERS)
+    def test_host_transform_raises_mid_stream(self, world8, workers):
+        """A transform failure at batch k surfaces after batches < k were
+        delivered — even when other workers already finished later
+        batches."""
+
+        def bad_tf(b):
+            if float(np.asarray(b["x"])[0, 0]) == 3.0:
+                raise ValueError("bad decode")
+            return b
+
+        with Prefetcher(
+            world8, _batches(8), host_workers=workers,
+            host_transform=bad_tf, depth=4,
+        ) as pf:
+            got = _values([next(pf) for _ in range(3)])
+            assert got == [0.0, 1.0, 2.0]
+            with pytest.raises(ValueError, match="bad decode"):
+                next(pf)
+
+    @pytest.mark.parametrize("workers", WORKERS)
+    def test_device_transform_raises_mid_stream(self, world8, workers):
+        calls = {"n": 0}
+
+        def bad_place(b):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise ValueError("bad placement")
+            return b
+
+        with Prefetcher(
+            world8, _batches(8), host_workers=workers, transform=bad_place
+        ) as pf:
+            assert _values([next(pf), next(pf)]) == [0.0, 1.0]
+            with pytest.raises(ValueError, match="bad placement"):
+                next(pf)
+
+
+class TestClose:
+    @pytest.mark.parametrize("workers", WORKERS)
+    def test_close_while_consumer_blocked(self, world8, workers):
+        """close() from another thread unblocks a consumer stuck in
+        __next__ on a stalled source (it sees StopIteration, not a
+        hang)."""
+        release = threading.Event()
+
+        def stalled():
+            yield {"x": np.zeros((8, 1), np.float32)}
+            release.wait(10)  # never released: consumer would block
+            yield {"x": np.ones((8, 1), np.float32)}
+
+        pf = Prefetcher(world8, stalled(), host_workers=workers)
+        next(pf)
+        got = {}
+
+        def consume():
+            try:
+                next(pf)
+                got["out"] = "batch"
+            except StopIteration:
+                got["out"] = "stop"
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.2)  # consumer is now blocked in __next__
+        pf.close()
+        t.join(timeout=5)
+        release.set()  # let the stalled generator's thread die
+        assert not t.is_alive(), "consumer still blocked after close()"
+        assert got["out"] == "stop"
+
+    @pytest.mark.parametrize("workers", WORKERS)
+    def test_close_joins_threads_midstream(self, world8, workers):
+        pf = Prefetcher(
+            world8, _batches(1000), depth=2, host_workers=workers
+        )
+        next(pf)
+        pf.close()
+        assert all(not t.is_alive() for t in pf._threads)
+
+    @pytest.mark.parametrize("workers", WORKERS)
+    def test_close_idempotent_after_exhaustion(self, world8, workers):
+        pf = Prefetcher(world8, _batches(3), host_workers=workers)
+        _values(pf)
+        pf.close()
+        pf.close()
+
+
+class TestAdaptiveDepth:
+    def test_depth_grows_under_starvation_and_is_capped(self, world8):
+        """A consumer that always blocks (slow source) drives depth up,
+        but never past max_depth."""
+
+        def slow():
+            for i in range(60):
+                time.sleep(0.005)
+                yield {"x": np.full((8, 1), float(i), np.float32)}
+
+        with Prefetcher(
+            world8, slow(), depth=2, max_depth=4, host_workers=1
+        ) as pf:
+            vals = _values(pf)
+        assert vals == [float(i) for i in range(60)]
+        assert 2 <= pf.depth <= 4
+
+    def test_depth_shrinks_back_to_floor_when_idle(self, world8):
+        """A fast source + slow consumer never blocks in __next__; an
+        adapted depth decays back toward the configured floor."""
+        with Prefetcher(
+            world8, _batches(40), depth=2, max_depth=6, host_workers=1
+        ) as pf:
+            pf._depth = 6  # as if a past starvation phase grew it
+            for b in pf:
+                time.sleep(0.002)  # consumer is the bottleneck
+        assert pf.depth == 2
