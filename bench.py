@@ -339,23 +339,41 @@ def bench_alexnet(
     }
 
 
-def _scaling(step_seconds, items_per_chip, params):
+def _scaling(step_seconds, items_per_chip, params, **kw):
     """The BASELINE 8→256 scaling-efficiency artifact (analytic, labeled
     ``modeled``; utils/profiling.scaling_projection). Two topologies:
     ``single_slice`` (up to 256 chips of ICI — one v5e pod) and
     ``slice64`` (64-chip slices joined by DCN — the cross-slice cliff).
     Detail-file-only: these blobs are what overflowed the driver's tail
-    buffer in round 3."""
+    buffer in round 3. Extra kwargs (the MoE alltoall terms) pass
+    through to scaling_projection."""
     from mpit_tpu.utils import scaling_projection
 
     return {
         "single_slice": scaling_projection(
-            step_seconds, items_per_chip, params, slice_size=256
+            step_seconds, items_per_chip, params, slice_size=256, **kw
         ),
         "slice64": scaling_projection(
-            step_seconds, items_per_chip, params, slice_size=64
+            step_seconds, items_per_chip, params, slice_size=64, **kw
         ),
     }
+
+
+def moe_alltoall_payload(cfg, moe, batch_per_device: int, seq: int) -> float:
+    """Per-chip routed-token bytes crossing the expert all-to-all per
+    STEP (modeled; the scaling projection's ISSUE 3 satellite input):
+    each MoE layer shuffles ~k slots per local token, d_model bf16 each,
+    over ``moe_alltoall_passes`` distinct all-to-alls."""
+    local_tokens = batch_per_device * seq
+    return moe_alltoall_passes(cfg, moe) * moe.k * local_tokens \
+        * cfg.d_model * 2.0
+
+
+def moe_alltoall_passes(cfg, moe) -> int:
+    """Distinct all-to-alls per step: dispatch + return, forward +
+    backward (4), per MoE layer — each pays ring-hop latency separately
+    in the scaling model."""
+    return 4 * (cfg.num_layers // moe.every)
 
 
 def bench_resnet(
@@ -597,18 +615,46 @@ def bench_moe(calls: int = 4, warmup: int = 1, seq: int = 512, batch_per_device:
     # Routing observability: drop rate / expert load on a probe forward
     # (mutable intermediates; never part of the timed window).
     probe = jnp.asarray(next(stream)["tokens"][: max(batch // 4, 1), :-1])
-    _, inter = jax.jit(
+    probe_fn = jax.jit(
         lambda p, t: model.apply(
             {"params": p}, t, mutable=["intermediates"]
         )
-    )(state.params, probe)
-    drops = [
-        float(v)
-        for k, v in jax.tree_util.tree_flatten_with_path(
-            inter["intermediates"]
-        )[0]
-        if "drop_rate" in jax.tree_util.keystr(k) and v.ndim == 0
-    ]
+    )
+
+    def _drops(params):
+        _, inter = probe_fn(params, probe)
+        return [
+            float(v)
+            for k, v in jax.tree_util.tree_flatten_with_path(
+                inter["intermediates"]
+            )[0]
+            if "drop_rate" in jax.tree_util.keystr(k) and v.ndim == 0
+        ]
+
+    drops = _drops(state.params)
+
+    # Load-balance under training (ISSUE 3 satellite): keep training the
+    # SAME state ~48 more steps, sampling the per-layer drop rate — the
+    # aux loss should pull the random-init 36–64% down materially. Each
+    # sample rides obs.gauge so the trajectory lands in the workload's
+    # telemetry too; the list goes to BENCH_DETAIL.json (detail-only).
+    from mpit_tpu import obs
+
+    trajectory = [{"step": 0, "drop_rate_per_moe_layer":
+                   [round(d, 4) for d in drops]}]
+    probe_every, probe_steps = 12, 48
+    with obs.span("moe_load_balance_probe", steps=probe_steps):
+        for s in range(1, probe_steps + 1):
+            state, _m = step_fn(state, batches[s % 2])
+            if s % probe_every == 0:
+                ds = _drops(state.params)
+                for li, d in enumerate(ds):
+                    obs.gauge("moe_drop_rate", d, layer=li, step=s)
+                trajectory.append(
+                    {"step": s,
+                     "drop_rate_per_moe_layer": [round(d, 4) for d in ds]}
+                )
+
     return {
         "tokens_per_sec": round(batch * seq * steps / dt, 1),
         "ms_per_step": round(dt / steps * 1e3, 2),
@@ -621,7 +667,19 @@ def bench_moe(calls: int = 4, warmup: int = 1, seq: int = 512, batch_per_device:
         "capacity_factor": moe.capacity_factor,
         "zero1": zero1,
         "drop_rate_per_moe_layer": [round(d, 4) for d in drops],
+        "drop_rate_trajectory": trajectory,
         "final_loss": round(final_loss, 4),
+        # The scaling block the round-5 verdict flagged as missing
+        # (next-round #6): grad-sync model PLUS the expert all-to-all
+        # (collective_bytes "alltoall" wired into scaling_projection).
+        "scaling": _scaling(
+            dt / steps, batch_per_device * seq, params,
+            alltoall_payload_bytes=moe_alltoall_payload(
+                cfg, moe, batch_per_device, seq
+            ),
+            alltoall_group=moe.num_experts,
+            alltoall_passes=moe_alltoall_passes(cfg, moe),
+        ),
     }
 
 
@@ -636,19 +694,35 @@ def bench_allreduce(payload_mb: int = 64, iters: int = 10):
     import mpit_tpu
     from jax.sharding import PartitionSpec as P
     from mpit_tpu.comm import collectives as C
-    from mpit_tpu.utils import TPU_V5E, allreduce_gbps, collective_bytes
+    from mpit_tpu.utils import TPU_V5E, allreduce_gbps
 
     world = mpit_tpu.init()
     n = world.num_devices
     payload = payload_mb * 1024 * 1024
     if n == 1:
-        wire = collective_bytes(payload, 8)
-        # Ring time with both ICI directions busy; algorithm bandwidth.
-        modeled = payload / (wire / (2 * TPU_V5E.ici_bandwidth)) / 1e9
+        from mpit_tpu.utils import modeled_allreduce_seconds
+
+        # Latency-aware ring model (utils/profiling.py): the derived
+        # GB/s now MOVES with payload (small payloads latency-bound,
+        # large ones approach the 2×ICI wire ceiling) instead of the
+        # constant a latency-free model produced for four rounds
+        # (round-5 verdict missing #3). Still modeled, still labeled.
+        modeled = payload / modeled_allreduce_seconds(payload, 8) / 1e9
         return {
             "gbps": round(modeled, 2),
             "modeled": True,
-            "note": "1 device: no-op collective; ICI-roofline estimate for 8 chips",
+            "payload_mb": payload_mb,
+            "by_payload_mb": {
+                str(mb): round(
+                    (mb * 2**20)
+                    / modeled_allreduce_seconds(mb * 2**20, 8) / 1e9,
+                    2,
+                )
+                for mb in (1, 4, 16, 64, 256)
+            },
+            "ici_hop_latency_us_assumed": TPU_V5E.ici_hop_latency * 1e6,
+            "note": "1 device: no-op collective; latency-aware ICI ring "
+                    "estimate for 8 chips",
         }
     # MPI convention (and the modeled branch above): ``payload`` is the
     # PER-RANK buffer each device reduces — so lay out n × payload bytes
@@ -693,12 +767,13 @@ def _round1_baselines():
     return alex, gpt2
 
 
-def _phase_breakdown(rec) -> dict:
+def _phase_breakdown(s: dict) -> dict:
     """Per-workload obs roll-up for BENCH_DETAIL.json (never the record
     line — ``_LINE_KEYS`` whitelists what rides there): where the
     workload's wall clock went, plus the top collectives by modeled
-    wire bytes from the trace-time accounting in comm/collectives."""
-    s = rec.summary(top_collectives=3)
+    wire bytes from the trace-time accounting in comm/collectives.
+    ``s`` is the workload's ``Recorder.summary()`` (computed once in
+    main, shared with the obs_baseline snapshot)."""
     out = {
         name: {"count": p["count"], "total_s": round(p["total_s"], 3)}
         for name, p in s["phases"].items()
@@ -913,7 +988,20 @@ def main():
         # Wall seconds the workload took end to end (compile + staging +
         # measurement) — the time-budget diagnostic; detail-file only.
         em.results[name]["wall_s"] = round(time.perf_counter() - t_w, 1)
-        em.results[name]["phases"] = _phase_breakdown(rec)
+        summ = rec.summary(top_collectives=3)
+        em.results[name]["phases"] = _phase_breakdown(summ)
+        # Perf-regression gate input (ISSUE 3; obs/baseline.py): the
+        # full per-phase snapshot (count/total/p50/p95) in the shape
+        # `python -m mpit_tpu.obs diff BENCH_DETAIL.json <new> --workload
+        # <name>` consumes — so two bench rounds diff mechanically.
+        # Only for workloads that actually MEASURED: an errored one
+        # would snapshot just its enclosing 'workload' span, and a
+        # later diff against that gate-passes vacuously (every real
+        # phase lands in new_phases, which is reported, not gated).
+        if "error" not in em.results[name]:
+            em.results[name]["obs_baseline"] = obs.baseline.snapshot(
+                summ, meta={"workload": name}
+            )
         em.emit(pending=[n for n, _ in workloads[i + 1:]])
 
     obs.disable()

@@ -105,6 +105,11 @@ class TrainConfig:
     # while the loop observably starves on input (each unit = one staged
     # device batch of HBM). Set equal to prefetch_depth to disable.
     prefetch_max_depth: int = 8
+    # Step-time anomaly sentinel (ISSUE 3; obs/sentinel.py): a rolling
+    # median/MAD detector over step wall / prefetch wait / host fences
+    # that emits structured `anomaly` events and a run-end report —
+    # DivergenceGuard for throughput. Off by default (zero overhead).
+    sentinel: bool = False
     seed: int = 0
 
     def mesh_shape(self) -> dict[str, int] | None:

@@ -189,6 +189,7 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             prefetch_workers=cfg.prefetch_workers,
             prefetch_depth=cfg.prefetch_depth,
             prefetch_max_depth=cfg.prefetch_max_depth,
+            sentinel=runner._make_sentinel(cfg),
         )
         tier_info.update(
             preempted=result["preempted"], restores=result["restores"]

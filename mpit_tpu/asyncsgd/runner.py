@@ -199,6 +199,17 @@ def run_meta(cfg: TrainConfig) -> dict:
     return meta
 
 
+def _make_sentinel(cfg: TrainConfig):
+    """The step-time anomaly sentinel behind ``--sentinel true``
+    (ISSUE 3; obs/sentinel.py). None when disabled — the loop then pays
+    nothing for it."""
+    if not cfg.sentinel:
+        return None
+    from mpit_tpu.obs import Sentinel
+
+    return Sentinel()
+
+
 def build_tx(cfg: TrainConfig, *, axis: str | None = None):
     """The goo transformation for a config (Downpour-SGD or EASGD chain),
     with the config's lr schedule (constant when ``cfg.schedule`` is "")."""
@@ -415,6 +426,7 @@ def run_spmd(
         prefetch_workers=cfg.prefetch_workers,
         prefetch_depth=cfg.prefetch_depth,
         prefetch_max_depth=cfg.prefetch_max_depth,
+        sentinel=_make_sentinel(cfg),
     )
     state = result["state"]
 

@@ -143,6 +143,36 @@ class World:
         )
         return f(x)
 
+    def gather_host_bytes(self, payload: bytes) -> list[bytes]:
+        """All-gather an arbitrary host byte string across processes.
+
+        The flight-recorder transport for REAL multi-process runs
+        (``obs.aggregate.gather_distributed``): each process contributes
+        its serialized telemetry; every process receives the full
+        process-ordered list (index = ``process_index``). Variable
+        lengths are handled by a size exchange + zero-padding to the
+        max. Single-process worlds short-circuit without touching the
+        collective machinery.
+
+        This is a COLLECTIVE over processes — every process of the world
+        must call it, in the same program order as its other
+        cross-process collectives, or the job deadlocks (the standard
+        multi-host contract, same as checkpointing).
+        """
+        if self.process_count == 1:
+            return [bytes(payload)]
+        from jax.experimental import multihost_utils
+
+        sizes = multihost_utils.process_allgather(
+            np.asarray(len(payload), np.int64)
+        )
+        buf = np.zeros(int(sizes.max()), np.uint8)
+        buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+        gathered = multihost_utils.process_allgather(buf)
+        return [
+            bytes(gathered[i, : int(sizes[i])]) for i in range(len(sizes))
+        ]
+
     def __repr__(self) -> str:  # readable in logs
         shape = ",".join(f"{k}={v}" for k, v in self.mesh.shape.items())
         return (
@@ -177,6 +207,22 @@ def _maybe_distributed_initialize() -> None:
     )
     n_proc = os.environ.get("JAX_NUM_PROCESSES")
     if coord and n_proc:
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # A multi-PROCESS world on the CPU backend needs a real
+            # cross-host collectives transport or the first global
+            # computation dies with "Multiprocess computations aren't
+            # implemented on the CPU backend" (ISSUE 3: the multi-host
+            # e2e only got this far once PYTHONPATH stopped masking it).
+            # Gloo TCP is jax's supported CPU implementation; set it
+            # before the backend initializes unless the caller chose one
+            # (the env var, read at jax import, wins if present).
+            if not os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION"):
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo"
+                    )
+                except Exception:
+                    pass  # jaxlib without the flag: preserve behavior
         try:
             jax.distributed.initialize(
                 coordinator_address=coord,

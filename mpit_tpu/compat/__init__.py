@@ -59,6 +59,7 @@ from mpit_tpu.compat.simulator import (  # noqa: F401
     Barrier,
     Bcast,
     Comm,
+    Comm_dup,
     Comm_rank,
     Comm_size,
     Finalize,

@@ -135,6 +135,13 @@ class Request:
         self._rank = rank
         self._done = kind == "send"
         self.status: Status | None = None
+        # Receive-side obs attribution (ISSUE 3): capture the POSTING
+        # thread's recorder now — delivery may run on the *sender's*
+        # thread via put(), and under per-rank thread-local recorders
+        # (obs.local_recorder) the bytes must land in the RECEIVER's
+        # stream, or the merged flight-recorder matrix mis-attributes
+        # every eagerly-delivered message to its sender's rank.
+        self._obs_rec = _obs.get_recorder() if kind == "recv" else None
 
     def _complete_locked(self, msg: _Message) -> None:
         """Deliver ``msg`` into this request's buffer. Caller holds the
@@ -145,15 +152,23 @@ class Request:
         self._buf[...] = flat.reshape(self._buf.shape)
         self.status = Status(source=msg.src, tag=msg.tag, count=flat.size)
         self._done = True
-        if _obs.enabled():
-            # Receive-side accounting: counts at DELIVERY (the matching
-            # moment), which may run on the sender's thread via put() —
-            # the obs counters are global and thread-safe, and the obs
-            # lock never nests inside the mailbox lock the other way.
-            _obs.counter(
-                "p2p_recv_bytes", flat.nbytes, src=msg.src, dst=self._rank
-            )
-            _obs.counter("p2p_recv_msgs", 1, src=msg.src, dst=self._rank)
+        # Counts at DELIVERY (the matching moment) into the receiver's
+        # recorder captured at post time — the obs lock never nests
+        # inside the mailbox lock the other way. A delivery that lands
+        # after the recorder was drained (a recv outstanding across a
+        # flight-recorder gather) credits the SAME still-installed
+        # object and ships with the next interval — interval
+        # accounting, not loss. Fallback: a recv posted before
+        # obs.enable() still counts against the GLOBAL recorder live at
+        # delivery (never the delivering thread's thread-local one,
+        # which may belong to the SENDER's rank).
+        rec = self._obs_rec
+        if rec is None:
+            rec = _obs.get_global_recorder()
+        if rec is not None:
+            attrs = {"src": msg.src, "dst": self._rank}
+            rec.add_counter("p2p_recv_bytes", flat.nbytes, attrs)
+            rec.add_counter("p2p_recv_msgs", 1, attrs)
 
     def wait(self) -> Status | None:
         """Block until complete — ``mpiT.Wait`` analogue."""
@@ -260,13 +275,26 @@ class Comm:
         self._boxes = [_Mailbox() for _ in range(size)]
         self._barrier = threading.Barrier(size)
         self._slots: list[Any] = [None] * size
+        self._dup_lock = threading.Lock()
+        self._dups: dict[str, "Comm"] = {}
+        self._aborted = False
 
     # -- collective rendezvous ------------------------------------------------
     def abort(self) -> None:
-        """Abort the job: break the barrier and wake all blocked receivers."""
+        """Abort the job: break the barrier and wake all blocked
+        receivers — on this communicator AND its dups (a rank parked in
+        Recv on a duplicated communicator must die with the job too).
+        The flag makes the abort durable: a dup created AFTER the abort
+        (a survivor rank entering a gather while a peer is already
+        dead) is born aborted instead of parking its creator forever."""
+        with self._dup_lock:
+            self._aborted = True
+            dups = list(self._dups.values())
         self._barrier.abort()
         for box in self._boxes:
             box.abort()
+        for d in dups:
+            d.abort()
 
     def _exchange(self, rank: int, value: Any) -> list[Any]:
         """Deposit ``value``, wait for all ranks, return everyone's deposits.
@@ -368,6 +396,32 @@ def Get_processor_name() -> str:
     import platform
 
     return platform.node() or "localhost"
+
+
+def Comm_dup(comm: Comm | None = None, *, key: str = "dup") -> Comm:
+    """``MPI_Comm_dup`` analogue: a communicator with the same group but
+    a SEPARATE matching space (own mailboxes, own barrier).
+
+    The MPI reason to dup is exactly why this exists here: library
+    traffic (e.g. the flight recorder's snapshot shipments,
+    ``obs.aggregate.gather_compat``) must be un-stealable by the
+    application's outstanding wildcard receives — an ``ANY_TAG`` Irecv
+    posted on the parent can never match a message sent on the dup.
+    Lazily created once per ``(comm, key)`` and shared by all ranks
+    (the parent Comm object is the shared rendezvous point); aborting
+    the parent aborts its dups.
+    """
+    c = _resolve(comm)
+    with c._dup_lock:
+        d = c._dups.get(key)
+        if d is None:
+            d = c._dups[key] = Comm(c.size, name=f"{c.name}.{key}")
+            if c._aborted:
+                # Parent died before this dup existed: the dup is born
+                # aborted, so a survivor blocking on it gets the
+                # AbortedError instead of a deadlock.
+                d.abort()
+    return d
 
 
 # -- point-to-point ----------------------------------------------------------

@@ -23,6 +23,23 @@ operations. This package is that layer:
 - :func:`traffic_matrix` — the rank×rank P2P byte matrix accumulated by
   the :mod:`mpit_tpu.compat` simulator for parity runs.
 
+ISSUE 3 grows the recorder distributed, plus an automated verdict pair:
+
+- :mod:`~mpit_tpu.obs.aggregate` — the cross-rank flight recorder:
+  per-rank recorders (:func:`local_recorder` thread-local override for
+  simulator rank threads) gathered to rank 0 over compat Send/Recv or
+  ``World.gather_host_bytes``; ONE merged Chrome trace with a Perfetto
+  lane per rank, a per-phase skew report naming the straggler, and a
+  measured rank×rank P2P matrix reconciled against the modeled one;
+- :class:`Sentinel` (:mod:`~mpit_tpu.obs.sentinel`) — the step-time
+  anomaly detector ``hardened_loop`` wires in behind ``sentinel=`` /
+  ``--sentinel true``: rolling median/MAD over step wall / prefetch
+  wait / host fences, structured ``anomaly`` instants, run-end report;
+- :mod:`~mpit_tpu.obs.baseline` — per-phase perf snapshots and the
+  regression gate behind ``python -m mpit_tpu.obs diff`` (non-zero exit
+  on phase-time regressions beyond ``--tolerance-pct``); ``bench.py``
+  writes one per workload into ``BENCH_DETAIL.json``.
+
 Instrumented call sites: ``train.loop.hardened_loop`` (prefetch-wait /
 step / host-fence / eval / checkpoint / divergence-restore phases),
 ``comm.collectives`` (per-op modeled wire bytes — recorded at *trace*
@@ -36,6 +53,7 @@ fast path costs a module-global check and the package can be imported
 from anywhere in the stack without cycles.
 """
 
+from mpit_tpu.obs import aggregate, baseline
 from mpit_tpu.obs.core import (
     Recorder,
     counter,
@@ -46,17 +64,23 @@ from mpit_tpu.obs.core import (
     gauge,
     get_recorder,
     instant,
+    local_recorder,
     span,
     summary,
 )
 from mpit_tpu.obs.export import (
     export_chrome_trace,
     export_jsonl,
+    snapshot_trace_events,
     traffic_matrix,
 )
+from mpit_tpu.obs.sentinel import Sentinel
 
 __all__ = [
     "Recorder",
+    "Sentinel",
+    "aggregate",
+    "baseline",
     "counter",
     "disable",
     "enable",
@@ -67,6 +91,8 @@ __all__ = [
     "gauge",
     "get_recorder",
     "instant",
+    "local_recorder",
+    "snapshot_trace_events",
     "span",
     "summary",
     "traffic_matrix",

@@ -1,21 +1,31 @@
-"""``python -m mpit_tpu.obs`` — trace summary + app-path gap report.
+"""``python -m mpit_tpu.obs`` — trace summary, gap report, and the
+perf-regression gate.
 
-Reads an exported obs timeline — either the Chrome-trace JSON written by
-:func:`mpit_tpu.obs.export_chrome_trace` or the JSONL stream written by
-:func:`mpit_tpu.obs.export_jsonl` — rebuilds the phase roll-up offline,
-and prints the same summary/gap-attribution JSON the live recorder
-produces (ISSUE 2 satellite: the gap report without re-running the
-workload, for traces shipped off a pod).
+**Trace mode** reads an exported obs timeline — either the Chrome-trace
+JSON written by :func:`mpit_tpu.obs.export_chrome_trace` or the JSONL
+stream written by :func:`mpit_tpu.obs.export_jsonl` — rebuilds the phase
+roll-up offline, and prints the same summary/gap-attribution JSON the
+live recorder produces (ISSUE 2 satellite: the gap report without
+re-running the workload, for traces shipped off a pod).
+
+**Diff mode** (ISSUE 3: the perf-regression gate) compares two
+``obs.baseline`` phase snapshots and exits non-zero on a phase-time
+regression beyond tolerance — the CI hook that makes a silent slowdown
+a red exit code.
 
 Usage::
 
     python -m mpit_tpu.obs trace.json            # summary + gap report
     python -m mpit_tpu.obs obs.jsonl --top 10    # widen the phase table
     python -m mpit_tpu.obs trace.json --gap-only # just the attribution
+    python -m mpit_tpu.obs diff base.json cur.json --tolerance-pct 10
+    python -m mpit_tpu.obs diff BENCH_DETAIL.json BENCH_DETAIL.new.json \
+        --workload alexnet                       # bench snapshots
 
-Exit status: 0 on success, 2 when the file holds no span events (a
-truncated or foreign trace — don't let an empty gap report read as "no
-overhead").
+Exit status: 0 on success; trace mode exits 2 when the file holds no
+span events (a truncated or foreign trace — don't let an empty gap
+report read as "no overhead"); diff mode exits 1 on regressions beyond
+tolerance and 2 on unusable input.
 """
 
 from __future__ import annotations
@@ -24,6 +34,7 @@ import argparse
 import json
 import sys
 
+from mpit_tpu.obs import baseline
 from mpit_tpu.obs.core import gap_attribution, phase_stats
 
 
@@ -75,7 +86,41 @@ def _summarize(durs: dict) -> dict:
     }
 
 
+def _main_diff(argv) -> int:
+    """The ``diff`` subcommand: the perf-regression gate."""
+    ap = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.obs diff",
+        description="Diff two obs.baseline phase snapshots; exit 1 on "
+        "phase-time regressions beyond tolerance.",
+    )
+    ap.add_argument("baseline", help="baseline snapshot (obs.baseline JSON, "
+                    "a raw summary dump, or BENCH_DETAIL.json)")
+    ap.add_argument("current", help="current snapshot (same shapes)")
+    ap.add_argument(
+        "--tolerance-pct", type=float, default=10.0,
+        help="allowed per-phase p50 growth before the gate trips (%%)",
+    )
+    ap.add_argument(
+        "--workload", default=None,
+        help="workload entry to read when a file is a BENCH_DETAIL.json",
+    )
+    args = ap.parse_args(argv)
+    try:
+        base = baseline.load(args.baseline, workload=args.workload)
+        cur = baseline.load(args.current, workload=args.workload)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(json.dumps({"error": str(e)}))
+        return 2
+    verdict = baseline.diff(base, cur, tolerance_pct=args.tolerance_pct)
+    print(json.dumps(verdict, indent=1))
+    return 0 if verdict["ok"] else 1
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "diff":
+        return _main_diff(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m mpit_tpu.obs",
         description="Offline trace summary + app-path gap attribution.",

@@ -1,0 +1,157 @@
+"""Perf-baseline snapshots + the regression gate behind ``obs diff``.
+
+VERDICT round 5's complaint: perf claims regress silently between
+rounds (AlexNet flat/declining r02→r05) because nothing DIFFS two runs.
+This module is the offline half of the fix (the runtime half is
+``obs.sentinel``):
+
+- :func:`snapshot` / :func:`save` — a per-phase ``summary()`` snapshot
+  (count / total / p50 / p95 per phase, plus counters) in a
+  version-tagged JSON shape;
+- :func:`load` — reads a baseline file, a raw summary dict, or a
+  ``BENCH_DETAIL.json`` (pick the workload with ``workload=``, whose
+  snapshot ``bench.py`` writes under ``obs_baseline``);
+- :func:`diff` — the gate: per-phase comparison, regression when the
+  current **p50** exceeds baseline by more than ``tolerance_pct``
+  (p50 per occurrence, so a run with more steps isn't a "regression";
+  ``total_s`` deltas are reported for context, never gated on).
+
+CLI: ``python -m mpit_tpu.obs diff <baseline> <current>
+--tolerance-pct N`` exits 0 when clean, 1 on regressions, 2 on unusable
+input — wire it after ``bench.py`` (or any two exported runs) and a
+silent slowdown becomes a red exit code.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Mapping
+
+from mpit_tpu.obs import core
+
+FORMAT = "mpit-obs-baseline-v1"
+
+__all__ = ["FORMAT", "diff", "load", "save", "snapshot"]
+
+
+def snapshot(
+    summary: Mapping[str, Any] | None = None, *, meta: Mapping | None = None
+) -> dict:
+    """A baseline snapshot from a ``summary()``-shaped dict (default:
+    the calling thread's installed recorder)."""
+    if summary is None:
+        summary = core.summary()
+    if not summary:
+        raise RuntimeError(
+            "no summary to snapshot — obs is disabled and none was passed"
+        )
+    out: dict[str, Any] = {
+        "format": FORMAT,
+        "phases": {
+            name: {k: p[k] for k in ("count", "total_s", "p50_s", "p95_s")
+                   if k in p}
+            for name, p in summary.get("phases", {}).items()
+        },
+        "counters": dict(summary.get("counters", {})),
+    }
+    if meta:
+        out["meta"] = dict(meta)
+    return out
+
+
+def save(
+    path: str | Path,
+    summary: Mapping[str, Any] | None = None,
+    *,
+    meta: Mapping | None = None,
+) -> Path:
+    """Write a baseline snapshot JSON (atomic) and return its path."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(snapshot(summary, meta=meta), f, indent=1)
+    tmp.replace(path)
+    return path
+
+
+def load(path: str | Path, *, workload: str | None = None) -> dict:
+    """Load a phase snapshot from any of the shapes the gate accepts.
+
+    - a :func:`save`d baseline file;
+    - a raw ``summary()`` dict dumped to JSON (``{"phases": ...}``);
+    - a ``BENCH_DETAIL.json`` — pass ``workload=`` to select the entry,
+      whose gate-ready snapshot lives under ``obs_baseline``.
+    """
+    with open(path) as f:
+        doc = json.load(f)
+    if "workloads" in doc:  # BENCH_DETAIL.json
+        if workload is None:
+            raise ValueError(
+                f"{path} is a BENCH_DETAIL file — pass workload= "
+                f"(one of {sorted(doc['workloads'])})"
+            )
+        entry = doc["workloads"].get(workload)
+        if entry is None:
+            raise ValueError(
+                f"workload {workload!r} not in {sorted(doc['workloads'])}"
+            )
+        snap = entry.get("obs_baseline")
+        if snap is None:
+            raise ValueError(
+                f"workload {workload!r} carries no obs_baseline snapshot"
+            )
+        return snap
+    if "phases" not in doc:
+        raise ValueError(f"{path} holds no phase snapshot")
+    return doc
+
+
+def diff(
+    base: Mapping[str, Any],
+    cur: Mapping[str, Any],
+    *,
+    tolerance_pct: float = 10.0,
+) -> dict:
+    """The regression gate: compare two phase snapshots.
+
+    A phase REGRESSES when its current p50 exceeds the baseline p50 by
+    more than ``tolerance_pct``. Improvements and total_s drift are
+    reported, not gated. Phases only in one snapshot land in
+    ``missing_phases`` / ``new_phases`` (reported, not gated — a renamed
+    phase should fail review, not the gate).
+    """
+    bp = base.get("phases", {})
+    cp = cur.get("phases", {})
+    phases: dict[str, dict] = {}
+    regressions: list[str] = []
+    for name in sorted(set(bp) & set(cp)):
+        b, c = bp[name], cp[name]
+        b50, c50 = float(b.get("p50_s", 0.0)), float(c.get("p50_s", 0.0))
+        entry: dict[str, Any] = {
+            "base_p50_s": round(b50, 6),
+            "cur_p50_s": round(c50, 6),
+            "base_total_s": round(float(b.get("total_s", 0.0)), 6),
+            "cur_total_s": round(float(c.get("total_s", 0.0)), 6),
+        }
+        if b50 > 0:
+            delta = 100.0 * (c50 - b50) / b50
+            entry["delta_pct"] = round(delta, 2)
+            entry["regressed"] = bool(delta > tolerance_pct)
+        else:
+            # Un-comparable baseline (zero-duration phase): report only.
+            entry["delta_pct"] = None
+            entry["regressed"] = False
+        if entry["regressed"]:
+            regressions.append(name)
+        phases[name] = entry
+    out = {
+        "tolerance_pct": tolerance_pct,
+        "phases": phases,
+        "missing_phases": sorted(set(bp) - set(cp)),
+        "new_phases": sorted(set(cp) - set(bp)),
+        "regressions": regressions,
+        "ok": not regressions,
+    }
+    return out

@@ -29,6 +29,7 @@ Event model:
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Any, Iterator, Mapping
@@ -45,6 +46,7 @@ __all__ = [
     "gauge",
     "get_recorder",
     "instant",
+    "local_recorder",
     "span",
     "summary",
 ]
@@ -226,6 +228,24 @@ class Recorder:
 _RECORDER: Recorder | None = None
 _LOCK = threading.Lock()
 
+# Thread-local recorder override (ISSUE 3: the compat simulator's rank
+# THREADS each need their own event stream for cross-rank aggregation —
+# the process-global recorder would merge every rank into one lane).
+# `_TLS_ACTIVE` counts installed overrides so the disabled fast path
+# stays two module-global reads when nobody uses the feature.
+_TLS = threading.local()
+_TLS_ACTIVE = 0
+
+
+def _current() -> Recorder | None:
+    """The recorder the CALLING THREAD should record into: its
+    thread-local override when one is installed, else the global."""
+    if _TLS_ACTIVE:
+        rec = getattr(_TLS, "recorder", None)
+        if rec is not None:
+            return rec
+    return _RECORDER
+
 
 class _NoopSpan:
     """Shared do-nothing context manager — the disabled fast path. A
@@ -263,7 +283,7 @@ class _Span:
         return self
 
     def __exit__(self, *exc):
-        rec = _RECORDER
+        rec = _current()
         if rec is not None:
             rec.add_span(self.name, self.t0, time.perf_counter(), self.attrs)
         return False
@@ -291,45 +311,79 @@ def disable() -> None:
 
 
 def enabled() -> bool:
-    return _RECORDER is not None
+    return _current() is not None
 
 
 def get_recorder() -> Recorder | None:
+    """The calling thread's recorder (thread-local override first)."""
+    return _current()
+
+
+def get_global_recorder() -> Recorder | None:
+    """The process-global recorder only, IGNORING any thread-local
+    override. For code that records on behalf of ANOTHER thread (the
+    compat simulator delivers receives on the sender's thread) and must
+    not leak events into the delivering thread's per-rank stream."""
     return _RECORDER
+
+
+@contextlib.contextmanager
+def local_recorder(recorder: Recorder | None = None):
+    """Install a THREAD-LOCAL recorder for the enclosed block.
+
+    While active, every primitive called on this thread records into it
+    instead of the process-global recorder — the per-rank event stream
+    the compat simulator's rank threads need for cross-rank aggregation
+    (``obs.aggregate``). Other threads are untouched. Nests: the
+    previous override (or the global) is restored on exit. Yields the
+    recorder so ``with obs.local_recorder() as rec:`` reads naturally.
+    """
+    global _TLS_ACTIVE
+    rec = recorder if recorder is not None else Recorder()
+    prev = getattr(_TLS, "recorder", None)
+    with _LOCK:
+        _TLS_ACTIVE += 1
+    _TLS.recorder = rec
+    try:
+        yield rec
+    finally:
+        _TLS.recorder = prev
+        with _LOCK:
+            _TLS_ACTIVE -= 1
 
 
 def span(name: str, **attrs):
     """Context manager timing a named phase. Disabled: returns the
     shared no-op instance (no allocation)."""
-    if _RECORDER is None:
+    if _current() is None:
         return _NOOP
     return _Span(name, attrs or None)
 
 
 def instant(name: str, **attrs) -> None:
     """Zero-duration marker event."""
-    rec = _RECORDER
+    rec = _current()
     if rec is not None:
         rec.add_instant(name, attrs or None)
 
 
 def counter(name: str, value: float = 1.0, **attrs) -> None:
     """Accumulate ``value`` onto the counter keyed by name + attrs."""
-    rec = _RECORDER
+    rec = _current()
     if rec is not None:
         rec.add_counter(name, value, attrs or None)
 
 
 def gauge(name: str, value: float, **attrs) -> None:
     """Set the last-value gauge keyed by name + attrs."""
-    rec = _RECORDER
+    rec = _current()
     if rec is not None:
         rec.add_gauge(name, value, attrs or None)
 
 
 def summary(*, top_collectives: int = 5, since: int = 0) -> dict:
-    """Summary of the installed recorder ({} when disabled)."""
-    rec = _RECORDER
+    """Summary of the calling thread's recorder ({} when disabled)."""
+    rec = _current()
     if rec is None:
         return {}
     return rec.summary(top_collectives=top_collectives, since=since)

@@ -40,7 +40,6 @@ def chrome_trace_events(
     recorder's epoch.
     """
     rec = _require(recorder)
-    snap = rec.snapshot()
     if pid is None:
         pid = 0
         try:  # process_index when jax is up; obs itself never needs jax
@@ -49,7 +48,26 @@ def chrome_trace_events(
             pid = jax.process_index()
         except Exception:
             pass
+    return snapshot_trace_events(rec.snapshot(), pid=pid)
+
+
+def snapshot_trace_events(
+    snap: dict, *, pid: int = 0, pid_label: str | None = None
+) -> list[dict]:
+    """Chrome-trace events from a :meth:`Recorder.snapshot`/``drain`` dict.
+
+    The snapshot-shaped entry point exists for the distributed flight
+    recorder (ISSUE 3): rank snapshots shipped to rank 0 are plain dicts
+    (the Recorder object stays on its rank), and the merged trace gives
+    each rank its own ``pid`` so Perfetto renders one LANE PER RANK.
+    ``pid_label`` adds the process_name metadata row naming the lane.
+    """
     events: list[dict] = []
+    if pid_label:
+        events.append(
+            {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+             "args": {"name": pid_label}}
+        )
     for tid, name in sorted(snap["thread_names"].items()):
         events.append(
             {"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,

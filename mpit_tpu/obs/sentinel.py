@@ -1,0 +1,250 @@
+"""Step-time anomaly sentinel — ``DivergenceGuard`` for throughput.
+
+The robustness hooks watch the LOSS (``train/guard.py``); nothing
+watches the *wall clock*, and VERDICT round 5 shows why that matters:
+perf regressed silently across rounds. This module is the runtime half
+of the fix (the offline half is the ``obs.baseline`` regression gate):
+a rolling median/MAD detector over the loop's host-side phase times —
+step wall, prefetch wait, host fence — that flags
+
+- ``spike``: one observation far above the rolling median (a stall,
+  a preemption hiccup, a contended tunnel);
+- ``sustained_degradation``: several consecutive observations above a
+  lower threshold (the run got durably slower — a thermal throttle, a
+  neighbor, a regression that warmup hid);
+- ``prefetch_starvation``: prefetch wait dominating step wall for
+  several consecutive steps (input pipeline can't keep up).
+
+Detection is robust (median/MAD, not mean/std — one spike must not
+inflate its own baseline) with a relative floor on the MAD so
+near-constant synthetic workloads don't flag their own noise: the
+acceptance bar is an injected spike caught AND zero false positives
+over a clean 200-step run.
+
+Anomalies are emitted as structured ``obs.instant("anomaly", ...)``
+events (they land in the trace, next to the span that caused them) and
+accumulated for :meth:`Sentinel.report`, which ``hardened_loop``
+attaches to its result when a sentinel is wired in (``sentinel=`` /
+``--sentinel true``).
+
+Pure stdlib + the obs core: usable standalone on any stream of
+durations, not just the training loop.
+"""
+
+from __future__ import annotations
+
+import statistics
+from collections import deque
+from typing import Any
+
+from mpit_tpu.obs import core as _obs
+
+__all__ = ["Sentinel"]
+
+
+class _Detector:
+    """Rolling median/MAD detector for one metric."""
+
+    __slots__ = ("window", "count", "total", "above_streak", "in_excursion")
+
+    def __init__(self, window: int):
+        self.window = deque(maxlen=window)
+        self.count = 0
+        self.total = 0.0
+        self.above_streak = 0
+        # Are we INSIDE an above-baseline excursion? A spike alert fires
+        # only on the transition below→above, so a durable slowdown is
+        # one spike + sustained-degradation alerts, never a spike storm.
+        self.in_excursion = False
+
+    def baseline(self) -> tuple[float, float]:
+        med = statistics.median(self.window)
+        mad = statistics.median(abs(v - med) for v in self.window)
+        return med, mad
+
+    def push(self, value: float) -> None:
+        self.window.append(value)
+        self.count += 1
+        self.total += value
+
+
+class Sentinel:
+    """Anomaly detector over the loop's host-side phase times.
+
+    Args:
+      window: rolling-window length per metric (median/MAD baseline).
+      warmup: observations per metric before any verdicts — the first
+        steps carry compile/cache noise the baseline must not flag.
+      spike_mads: ``spike`` when value > median + spike_mads·MAD.
+      sustained_mads: lower bar for the consecutive-degradation check.
+      sustained_n: consecutive above-bar observations that make a
+        ``sustained_degradation`` (the streak then resets, so a durably
+        slow run re-alerts every ``sustained_n`` observations, not every
+        step).
+      mad_floor_pct: relative floor on the MAD (as % of the median) so a
+        near-constant metric's numeric jitter cannot trip the detector —
+        the zero-false-positive guarantee on clean synthetic runs.
+      starvation_ratio: ``prefetch_starvation`` when prefetch wait >
+        ratio × the loop's iteration wall for ``sustained_n``
+        consecutive steps.
+      max_anomalies: cap on retained anomaly records (counts keep
+        accumulating past it; the overflow is reported).
+    """
+
+    def __init__(
+        self,
+        *,
+        window: int = 64,
+        warmup: int = 8,
+        spike_mads: float = 8.0,
+        sustained_mads: float = 4.0,
+        sustained_n: int = 5,
+        mad_floor_pct: float = 5.0,
+        starvation_ratio: float = 0.5,
+        max_anomalies: int = 64,
+    ):
+        self.window = window
+        self.warmup = max(2, warmup)
+        self.spike_mads = spike_mads
+        self.sustained_mads = sustained_mads
+        self.sustained_n = max(1, sustained_n)
+        self.mad_floor_pct = mad_floor_pct
+        self.starvation_ratio = starvation_ratio
+        self.max_anomalies = max_anomalies
+        self._detectors: dict[str, _Detector] = {}
+        self._anomalies: list[dict] = []
+        self._counts: dict[str, int] = {}
+        self._starve_streak = 0
+
+    # -- recording ----------------------------------------------------------
+    def _emit(self, kind: str, metric: str, step: int, **extra) -> None:
+        self._counts[kind] = self._counts.get(kind, 0) + 1
+        record = {"kind": kind, "metric": metric, "step": int(step)}
+        record.update({k: round(v, 6) if isinstance(v, float) else v
+                       for k, v in extra.items()})
+        if len(self._anomalies) < self.max_anomalies:
+            self._anomalies.append(record)
+        # Structured instant: lands in the trace next to the guilty span.
+        _obs.instant("anomaly", **record)
+
+    def observe(self, metric: str, step: int, value: float) -> None:
+        """Feed one observation of ``metric`` (seconds) at ``step``."""
+        det = self._detectors.get(metric)
+        if det is None:
+            det = self._detectors[metric] = _Detector(self.window)
+        if det.count < self.warmup:
+            # Warmup: build the baseline, no verdicts.
+            det.push(value)
+            return
+        med, mad = det.baseline()
+        mad = max(mad, self.mad_floor_pct / 100.0 * med, 1e-12)
+        if value > med + self.spike_mads * mad:
+            det.count += 1
+            det.total += value
+            det.above_streak += 1
+            if not det.in_excursion:
+                # Transition below→above: a spike. Excluded from the
+                # rolling window — a ONE-OFF must not raise the
+                # baseline and mask a second, smaller anomaly.
+                det.in_excursion = True
+                self._emit(
+                    "spike", metric, step,
+                    value_s=value, median_s=med, mad_s=mad,
+                )
+            else:
+                # A CONTINUING excursion is not more spikes — it is the
+                # run durably slowing down: feed the window so the
+                # baseline adapts to the new normal (alerts stop once
+                # the median catches up), and name it as sustained
+                # degradation every sustained_n steps meanwhile.
+                det.window.append(value)
+                if det.above_streak >= self.sustained_n:
+                    self._emit(
+                        "sustained_degradation", metric, step,
+                        value_s=value, median_s=med,
+                        consecutive=det.above_streak,
+                    )
+                    det.above_streak = 0
+            return
+        if value > med + self.sustained_mads * mad:
+            # Above the lower bar: part of an excursion (a later
+            # spike-bar value is its continuation, not a fresh spike).
+            det.in_excursion = True
+            det.above_streak += 1
+            if det.above_streak >= self.sustained_n:
+                self._emit(
+                    "sustained_degradation", metric, step,
+                    value_s=value, median_s=med,
+                    consecutive=det.above_streak,
+                )
+                det.above_streak = 0
+        else:
+            det.in_excursion = False
+            det.above_streak = 0
+        det.push(value)
+
+    def observe_step(
+        self,
+        step: int,
+        *,
+        step_s: float,
+        prefetch_wait_s: float | None = None,
+        iteration_s: float | None = None,
+    ) -> None:
+        """Per-iteration feed from the loop: step wall (+ prefetch wait).
+
+        Also runs the starvation check — prefetch wait persistently
+        dominating the loop's ITERATION wall means the input pipeline,
+        not the device, is the binding resource. ``iteration_s`` is the
+        full iteration-to-iteration wall (the loop passes it; it covers
+        the fence blocking where device time surfaces on the async
+        path — judging against ``step_s`` alone would compare prefetch
+        wait to the µs-scale dispatch wall and cry starvation on
+        healthy device-bound runs). Fallback when absent:
+        ``step_s + prefetch_wait_s``.
+        """
+        self.observe("step", step, step_s)
+        if prefetch_wait_s is None:
+            return
+        self.observe("prefetch_wait", step, prefetch_wait_s)
+        denom = (
+            iteration_s if iteration_s is not None
+            else step_s + prefetch_wait_s
+        )
+        if prefetch_wait_s > self.starvation_ratio * max(denom, 1e-12):
+            self._starve_streak += 1
+            if self._starve_streak >= self.sustained_n:
+                self._emit(
+                    "prefetch_starvation", "prefetch_wait", step,
+                    prefetch_wait_s=prefetch_wait_s, step_s=step_s,
+                    consecutive=self._starve_streak,
+                )
+                self._starve_streak = 0
+        else:
+            self._starve_streak = 0
+
+    # -- reading ------------------------------------------------------------
+    def report(self) -> dict:
+        """End-of-run verdict: anomaly counts + records + per-metric
+        baselines. ``clean`` is the headline boolean."""
+        metrics: dict[str, Any] = {}
+        for name, det in sorted(self._detectors.items()):
+            entry = {
+                "count": det.count,
+                "total_s": round(det.total, 6),
+            }
+            if len(det.window) >= 2:
+                med, mad = det.baseline()
+                entry["median_s"] = round(med, 6)
+                entry["mad_s"] = round(mad, 6)
+            metrics[name] = entry
+        out = {
+            "clean": not self._counts,
+            "anomaly_counts": dict(sorted(self._counts.items())),
+            "anomalies": list(self._anomalies),
+            "metrics": metrics,
+        }
+        overflow = sum(self._counts.values()) - len(self._anomalies)
+        if overflow > 0:
+            out["anomalies_truncated"] = overflow
+        return out

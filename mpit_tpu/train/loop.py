@@ -105,6 +105,7 @@ def hardened_loop(
     prefetch_workers: int = 1,
     prefetch_depth: int = 2,
     prefetch_max_depth: int = 8,
+    sentinel=None,
 ) -> dict:
     """Drive ``step_fn`` from ``state`` to ``steps`` with full hardening.
 
@@ -154,6 +155,15 @@ def hardened_loop(
         fully synchronous fences. Divergence detection is delayed by at
         most ``fetch_lag`` fence intervals (checkpoint and eval points
         drain the pipeline first and stay exactly as safe as before).
+      sentinel: optional :class:`mpit_tpu.obs.Sentinel` (ISSUE 3) — the
+        step-time anomaly detector. When given, the loop feeds it the
+        host-side step wall, prefetch wait, and host-fence durations
+        every iteration; it emits structured ``anomaly`` instant events
+        (spike / sustained-degradation / prefetch-starvation) into the
+        obs trace and its :meth:`~mpit_tpu.obs.Sentinel.report` is
+        attached to the result as ``out["sentinel"]`` — the
+        ``DivergenceGuard``-for-throughput hook. ``None`` (default)
+        costs nothing.
       host_transform / prefetch_workers / prefetch_depth /
         prefetch_max_depth: the prefetch pipeline (``data/loader.py``):
         ``host_transform`` runs on ``prefetch_workers`` threads before
@@ -234,6 +244,7 @@ def hardened_loop(
     tracing = False
     trace_done = False
     step = start_step
+    sent_prev_t: float | None = None  # sentinel iteration-wall anchor
     # Dispatch-depth watermark: the most recent step whose metrics the
     # host has actually fetched. Consuming a PENDING fetch only syncs
     # the device up to that entry's step, so bounding "oldest pending
@@ -267,7 +278,12 @@ def hardened_loop(
         with obs.span(
             "host_fence", why=entry.kind, lag=at_step - entry.step
         ):
+            fence_t0 = time.perf_counter()
             vals = {k: float(v) for k, v in entry.metrics.items()}
+        if sentinel is not None:
+            sentinel.observe(
+                "host_fence", at_step, time.perf_counter() - fence_t0
+            )
         synced = max(synced, entry.step)
         if entry.kind == "fence":
             return
@@ -319,11 +335,13 @@ def hardened_loop(
                 # shows where each step's wall clock went — prefetch
                 # wait vs dispatch vs host fence vs eval/checkpoint.
                 exhausted = False
+                pf_t0 = time.perf_counter()
                 with obs.span("prefetch_wait"):
                     try:
                         batch = next(stream)
                     except StopIteration:
                         exhausted = True
+                pf_s = time.perf_counter() - pf_t0
                 try:
                     if exhausted or step >= steps:
                         # End of the run: consume whatever is still in
@@ -361,8 +379,28 @@ def hardened_loop(
                     ):
                         jax.profiler.start_trace(profile_dir)
                         tracing = True
+                    step_t0 = time.perf_counter()
                     with obs.span("step"):
                         state, metrics = step_fn(state, batch)
+                    if sentinel is not None:
+                        # Host-side wall per iteration (dispatch time on
+                        # the async path — spikes here mean the HOST
+                        # stalled; device-completion spikes surface at
+                        # the fences the sentinel also watches). The
+                        # iteration wall (observe-to-observe, covering
+                        # the fences in between) is the starvation
+                        # check's denominator.
+                        now = time.perf_counter()
+                        sentinel.observe_step(
+                            step,
+                            step_s=now - step_t0,
+                            prefetch_wait_s=pf_s,
+                            iteration_s=(
+                                now - sent_prev_t
+                                if sent_prev_t is not None else None
+                            ),
+                        )
+                        sent_prev_t = now
                     if tracing and step >= prof_window[1]:
                         with obs.span("host_fence", why="trace_window"):
                             float(metrics["loss"])  # host fetch: trace covers real work
@@ -536,6 +574,18 @@ def hardened_loop(
         out["items_per_sec_last"] = round(rate_trace[-1], 2)
     if last_eval:  # an empty sweep (val split < one batch) records nothing
         out["eval"] = last_eval
+    if sentinel is not None:
+        # The throughput verdict next to the loss one: anomaly counts +
+        # records + per-metric baselines (obs/sentinel.py). Logged so
+        # the JSONL stream carries it even when the caller drops `out`.
+        out["sentinel"] = sentinel.report()
+        logger.log(
+            step,
+            {"event": "sentinel_report",
+             "sentinel_clean": out["sentinel"]["clean"],
+             **{f"sentinel_{k}": v
+                for k, v in out["sentinel"]["anomaly_counts"].items()}},
+        )
     if obs.enabled():
         # End-of-run roll-up (ISSUE 1 tentpole): phase totals + top
         # collectives by modeled wire bytes, logged so the JSONL stream

@@ -22,6 +22,7 @@ from mpit_tpu.utils.profiling import (
     allreduce_gbps,
     collective_bytes,
     compiled_cost,
+    modeled_allreduce_seconds,
     roofline,
     scaling_projection,
     trace,
@@ -42,6 +43,7 @@ __all__ = [
     "allreduce_gbps",
     "collective_bytes",
     "compiled_cost",
+    "modeled_allreduce_seconds",
     "roofline",
     "scaling_projection",
     "trace",

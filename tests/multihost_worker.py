@@ -16,10 +16,48 @@ hang (the launcher enforces a timeout) fails the test.
 import json
 import os
 import sys
+import time
+
+
+def _flight_recorder_demo(world, pid: int, out_path: str) -> None:
+    """ISSUE 3: the distributed flight recorder over the real transport.
+
+    Each process records into its own recorder (process 1 carries an
+    injected straggler phase and a known P2P counter), ships it through
+    ``aggregate.gather_distributed`` (World.gather_host_bytes — a real
+    cross-process collective), and process 0 persists the merged flight
+    record + the per-rank-lane trace pid set for the launcher to check.
+    """
+    from mpit_tpu import obs
+    from mpit_tpu.obs import aggregate
+
+    rec = obs.enable(obs.Recorder())
+    with obs.span("fr_compute"):
+        time.sleep(0.25 if pid == 1 else 0.05)  # pid 1 = straggler
+    # A known directed traffic entry per process: the merged matrix must
+    # carry BOTH, though each process only recorded its own.
+    obs.counter(
+        "p2p_send_bytes", 1000.0 * (pid + 1),
+        src=pid, dst=(pid + 1) % world.process_count,
+    )
+    per_rank = aggregate.gather_distributed(world, rec)
+    obs.disable()
+    if pid == 0:
+        doc = {
+            "record": aggregate.flight_record(per_rank),
+            "trace_pids": sorted(
+                {e["pid"] for e in aggregate.merged_trace_events(per_rank)}
+            ),
+        }
+        with open(out_path, "w") as f:
+            json.dump(doc, f)
 
 
 def main() -> None:
     ckpt_dir = sys.argv[1]
+    flight_record = None
+    if "--flight-record" in sys.argv:
+        flight_record = sys.argv[sys.argv.index("--flight-record") + 1]
 
     import jax
     import jax.numpy as jnp
@@ -89,6 +127,9 @@ def main() -> None:
     assert len(shards) == n_local
     for sh in shards:
         np.testing.assert_array_equal(np.asarray(sh.data), want[sh.index])
+
+    if flight_record:
+        _flight_recorder_demo(world, pid, flight_record)
 
     print(
         "MULTIHOST_OK "

@@ -46,6 +46,21 @@ def _realistic_results():
         "host_share_pct": 2.9,
         "overlapped_s": {"prefetch_device_put": 0.1219},
     }
+    # The perf-regression-gate snapshot bench now writes per workload
+    # (ISSUE 3; obs/baseline.py) — detail-file-only, like phases.
+    obs_baseline = {
+        "format": "mpit-obs-baseline-v1",
+        "phases": {
+            name: {"count": 12, "total_s": 34.567, "p50_s": 2.880583,
+                   "p95_s": 3.123456}
+            for name in ("workload", "staging", "warmup", "timed_window",
+                         "hardened_loop", "host_fence", "step",
+                         "prefetch_wait")
+        },
+        "counters": {"collective_bytes": 426627216.4,
+                     "collective_calls": 24.0},
+        "meta": {"workload": "alexnet"},
+    }
     return {
         "alexnet": {
             "images_per_sec": 123456.78,
@@ -62,6 +77,7 @@ def _realistic_results():
             "grad_sync_bytes_per_step_modeled": 243786980.0,
             "scaling": scaling,
             "phases": phases,
+            "obs_baseline": obs_baseline,
         },
         "resnet50": {
             "images_per_sec": 12345.67,
@@ -73,6 +89,7 @@ def _realistic_results():
             "final_loss": 6.9088,
             "scaling": scaling,
             "phases": phases,
+            "obs_baseline": obs_baseline,
         },
         "gpt2": {
             "tokens_per_sec": 130301.5,
@@ -88,6 +105,7 @@ def _realistic_results():
             "final_loss": 10.8262,
             "scaling": scaling,
             "phases": phases,
+            "obs_baseline": obs_baseline,
         },
         "gpt2_moe": {
             "tokens_per_sec": 46123.9,
@@ -101,15 +119,28 @@ def _realistic_results():
             "zero1": True,
             "dispatch": "sort-ragged",
             "drop_rate_per_moe_layer": [0.3123] * 6,
+            "drop_rate_trajectory": [
+                {"step": 12 * i,
+                 "drop_rate_per_moe_layer": [0.3123] * 6}
+                for i in range(5)
+            ],
             "final_loss": 10.9262,
+            "scaling": scaling,
             "phases": phases,
+            "obs_baseline": obs_baseline,
         },
         "allreduce": {
-            "gbps": 51.43,
+            "gbps": 50.88,
             "modeled": True,
             "devices": 8,
-            "note": "1 device: no-op collective; ICI-roofline estimate",
+            "payload_mb": 64,
+            "by_payload_mb": {"1": 30.49, "4": 43.3, "16": 48.78,
+                              "64": 50.88, "256": 51.29},
+            "ici_hop_latency_us_assumed": 1.0,
+            "note": "1 device: no-op collective; latency-aware ICI ring "
+                    "estimate for 8 chips",
             "phases": phases,
+            "obs_baseline": obs_baseline,
         },
     }
 
@@ -148,13 +179,23 @@ class TestLineBudget:
         # Bulky blobs must NOT ride the line.
         assert "scaling" not in rec["detail"]["alexnet"]
         assert "drop_rate_per_moe_layer" not in rec["detail"]["gpt2_moe"]
+        # The gpt2_moe scaling block is back (ISSUE 3 satellite) and
+        # stays detail-file-only, like every other bulky blob.
+        assert "scaling" not in rec["detail"]["gpt2_moe"]
+        # The modeled allreduce figure is payload-sized now; the line
+        # carries gbps + modeled only — the payload curve is detail-only.
+        assert rec["detail"]["allreduce"]["modeled"] is True
+        assert "by_payload_mb" not in rec["detail"]["allreduce"]
         # The obs phase breakdown is detail-file-only too (ISSUE 1), and
-        # so is the gap ATTRIBUTION (the line carries only the pct).
+        # so are the gap ATTRIBUTION (the line carries only the pct),
+        # the perf-gate snapshot, and the MoE drop trajectory (ISSUE 3).
         for wl in rec["detail"].values():
             if isinstance(wl, dict):
                 assert "phases" not in wl
                 assert "gap_attribution" not in wl
                 assert "hardened_items_per_sec" not in wl
+                assert "obs_baseline" not in wl
+                assert "drop_rate_trajectory" not in wl
 
     def test_partial_record_parses(self):
         # Progressive emission: record printed after the headline only,

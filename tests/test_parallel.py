@@ -1728,6 +1728,98 @@ class TestExpertParallelTier:
 
 
 @pytest.mark.slow
+class TestMoELoadBalanceTraining:
+    """ISSUE 3 satellite (round-5 verdict next-round #7): the load-
+    balance aux must actually WORK under training — the per-layer drop
+    rate, 36–64% at random init with a tight capacity factor, has to
+    fall materially once the router trains. ~50 EP-tier steps on the
+    fake mesh, drop rates sampled via probe forwards and recorded
+    through obs.gauge (the same instrumentation bench.py's trajectory
+    probe uses)."""
+
+    def test_drop_rate_falls_under_training(self):
+        import mpit_tpu
+        from mpit_tpu import obs
+        from mpit_tpu.data import shard_batch
+        from mpit_tpu.models.gpt2_moe import GPT2MoE, MoESettings
+        from mpit_tpu.opt import goo_adam
+        from mpit_tpu.parallel import make_gpt2_moe_train_step
+
+        cfg = GPT2Config.tiny(
+            num_heads=2, max_seq_len=32, num_layers=2, dtype=jnp.float32
+        )
+        moe = MoESettings(
+            num_experts=8, k=2, capacity_factor=1.25, every=2
+        )
+        model = GPT2MoE(cfg, moe)
+        full = jax.jit(model.init)(
+            jax.random.key(0), jnp.zeros((1, 32), jnp.int32)
+        )["params"]
+        world = mpit_tpu.init({"data": 2, "expert": 4}, set_default=False)
+        # aux_weight 1.0 / lr 3e-4, measured on this exact config: the
+        # balance signal has to dominate what random-token xent can
+        # teach, and adam at 3e-3 overshoots the tiny router into
+        # oscillation (drop rate RISES).
+        init_fn, step_fn, _ = make_gpt2_moe_train_step(
+            cfg, moe, goo_adam(3e-4), world, aux_weight=1.0
+        )
+        state = init_fn(full)
+
+        probe_fn = jax.jit(
+            lambda p, t: model.apply(
+                {"params": p}, t, mutable=["intermediates"]
+            )
+        )
+        rng = np.random.RandomState(1)
+        probe = jnp.asarray(
+            np.random.RandomState(0).randint(0, 512, size=(16, 32))
+            .astype(np.int32)
+        )
+
+        def drops(params):
+            _, inter = probe_fn(params, probe)
+            return [
+                float(v)
+                for k, v in jax.tree_util.tree_flatten_with_path(
+                    inter["intermediates"]
+                )[0]
+                if "drop_rate" in jax.tree_util.keystr(k) and v.ndim == 0
+            ]
+
+        rec = obs.enable(obs.Recorder())
+        try:
+            initial = drops(state.params)
+            # Random-init routing against cf=1.25 drops a sizable
+            # fraction of (token, round) slots (~23% at this tiny shape;
+            # the bench-size model sits at the verdict's 36–64%).
+            assert 0.15 < float(np.mean(initial)) < 0.75, initial
+            steps = 50
+            for s in range(1, steps + 1):
+                toks = rng.randint(0, 512, size=(16, 33)).astype(np.int32)
+                state, _m = step_fn(
+                    state,
+                    shard_batch(
+                        world, {"tokens": toks}, spec=P(("data", "expert"))
+                    ),
+                )
+                if s % 10 == 0:
+                    for li, d in enumerate(drops(state.params)):
+                        obs.gauge("moe_drop_rate", d, layer=li, step=s)
+            final = drops(state.params)
+        finally:
+            obs.disable()
+        # Material improvement: the mean drop rate fell by at least a
+        # third from random init (it typically approaches ~0 as the
+        # router balances; a third is the regression floor, not the
+        # expectation).
+        assert np.mean(final) < 0.67 * np.mean(initial), (initial, final)
+        # The trajectory rode obs.gauge: one series per (layer, step).
+        gauges = rec.snapshot()["gauges"]
+        series = [k for (name, k) in gauges if name == "moe_drop_rate"]
+        assert len(series) == (steps // 10) * len(initial)
+
+
+@pytest.mark.slow
 class TestTierCheckpointing:
     """--ckpt-dir on the hand-driven tiers (round 2): restore against the
     tier's own state_specs + deterministic stream fast-forward."""
