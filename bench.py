@@ -4,8 +4,10 @@ Headline metric (BASELINE.json): AlexNet ImageNet images/sec, measured on
 the real SPMD training step (fwd/bwd/goo update, ZeRO-1 sharded state) on
 whatever devices are available. Secondary metrics ride in ``detail``:
 GPT-2 tokens/sec (the stretch config), ResNet-50 images/sec, the EP-tier
-MoE tokens/sec, and — when >1 device is present — measured allreduce
-GB/s (modeled otherwise, labeled as such; SURVEY.md §8.4.5).
+MoE tokens/sec, GPT-2 serving decode tokens/sec + request-latency
+p50/p95 on the continuous-batching engine (``mpit_tpu.serve``, ISSUE 4),
+and — when >1 device is present — measured allreduce GB/s (modeled
+otherwise, labeled as such; SURVEY.md §8.4.5).
 
 Driver contract (round-5 hardening — the round-3 record outgrew the
 driver's 2,000-char tail buffer and the round-4 run outgrew its time
@@ -683,6 +685,93 @@ def bench_moe(calls: int = 4, warmup: int = 1, seq: int = 512, batch_per_device:
     }
 
 
+def bench_gpt2_serve(
+    slots: int = 8,
+    prompt_len: int = 64,
+    max_new: int = 48,
+    requests: int = 24,
+    max_len: int = 128,
+):
+    """GPT-2 serving throughput/latency on the continuous-batching
+    engine (ISSUE 4): decode tokens/sec over the KV-cache decode path
+    plus per-request latency percentiles, measured on a synthetic
+    request stream saturating ``slots`` concurrent cache slots.
+
+    Warmup runs ONE request through the engine first so the two compiles
+    (prefill + decode — the engine's whole compiled surface) never land
+    inside a measured request's TTFT/latency; the engine then resets
+    (cache cleared, compiled steps kept) and the stream is measured
+    cold-queue: all requests submitted up front, so queue-wait and
+    slot-reuse are exercised (admissions > slots).
+    """
+    import mpit_tpu
+    from mpit_tpu import obs
+    from mpit_tpu.models import GPT2, GPT2Config
+    from mpit_tpu.serve import Engine, Request, Server
+
+    world = mpit_tpu.init()
+    del world  # serving is single-replica here; TP variant is test-covered
+    import numpy as np
+
+    cfg = GPT2Config.small(max_seq_len=max_len, head_dtype=jnp.bfloat16)
+    params = jax.jit(GPT2(cfg).init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = Engine(
+        cfg, params, slots=slots, max_len=max_len, prefill_len=prompt_len
+    )
+
+    rng = np.random.RandomState(0)
+    make_req = lambda i: Request(
+        rid=i,
+        prompt=rng.randint(0, cfg.vocab_size, size=prompt_len).tolist(),
+        max_new_tokens=max_new,
+    )
+
+    with obs.span("warmup", calls=1):
+        warm = Server(engine)
+        warm.submit(
+            Request(rid=-1, prompt=make_req(-1).prompt, max_new_tokens=2)
+        )
+        warm.run()
+        engine.reset()
+
+    server = Server(engine)
+    for i in range(requests):
+        server.submit(make_req(i))
+    rec = obs.get_recorder()
+    n0 = rec.event_count() if rec else 0
+    t0 = time.perf_counter()
+    server.run()
+    wall = time.perf_counter() - t0
+    stats = server.stats()
+    gen = stats["generated_tokens"]
+    # Each request's FIRST token is sampled by prefill; only the rest are
+    # decode-path work, so they alone ride the decode-phase denominator.
+    decode_tokens = gen - stats["requests_completed"]
+    decode_s = wall
+    if rec is not None:
+        phases = rec.summary(since=n0)["phases"]
+        decode_s = phases.get("decode", {}).get("total_s", wall)
+    return {
+        "decode_tokens_per_sec": (
+            round(decode_tokens / decode_s, 1) if decode_s else None
+        ),
+        "serve_tokens_per_sec": round(gen / wall, 1),
+        "latency_p50_s": stats.get("latency_p50_s"),
+        "latency_p95_s": stats.get("latency_p95_s"),
+        "ttft_p50_s": stats.get("ttft_p50_s"),
+        "ttft_p95_s": stats.get("ttft_p95_s"),
+        "slots": slots,
+        "requests": requests,
+        "generated_tokens": gen,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "ticks": stats["ticks"],
+        "occupancy_mean": stats["occupancy_mean"],
+    }
+
+
 def bench_allreduce(payload_mb: int = 64, iters: int = 10):
     """The BASELINE "allreduce GB/s" metric.
 
@@ -809,6 +898,10 @@ _LINE_KEYS = {
     "gpt2_moe": (
         "tokens_per_sec", "ms_per_step", "batch", "seq_len", "dispatch",
         "final_loss", "error",
+    ),
+    "gpt2_serve": (
+        "decode_tokens_per_sec", "latency_p50_s", "latency_p95_s",
+        "slots", "requests", "error",
     ),
     "allreduce": ("gbps", "modeled", "devices", "error"),
 }
@@ -939,6 +1032,7 @@ def main():
         ("gpt2", bench_gpt2),
         ("resnet50", bench_resnet),
         ("gpt2_moe", bench_moe),
+        ("gpt2_serve", bench_gpt2_serve),
     ]
 
     def _watchdog():

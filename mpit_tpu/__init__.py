@@ -38,6 +38,11 @@ This package is NOT a port. It is a ground-up TPU-first (JAX / XLA / Pallas /
 - ``mpit_tpu.asyncsgd``  — the application layer: parameter-server parity
   actors plus the TPU-native synchronous training entry points for the
   acceptance-ladder configs.
+- ``mpit_tpu.serve``     — continuous-batching GPT-2 inference: the pserver
+  request-loop capability re-grown as serving (preallocated per-slot KV
+  cache, one jitted prefill + one jitted decode over the slot batch, TP
+  variant on the Megatron block rules, dense-checkpoint ingestion, TTFT/
+  latency observability).
 """
 
 __version__ = "0.1.0"

@@ -113,14 +113,19 @@ class TrainConfig:
     seed: int = 0
 
     def mesh_shape(self) -> dict[str, int] | None:
-        """Parse ``"data=4,model=2"`` → ``{"data": 4, "model": 2}``."""
-        if not self.mesh:
-            return None
-        out: dict[str, int] = {}
-        for part in self.mesh.split(","):
-            k, _, v = part.partition("=")
-            out[k.strip()] = int(v)
-        return out
+        return parse_mesh(self.mesh)
+
+
+def parse_mesh(mesh: str) -> dict[str, int] | None:
+    """Parse ``"data=4,model=2"`` → ``{"data": 4, "model": 2}`` (shared
+    by every config dataclass carrying a ``mesh`` flag; ``""`` → None)."""
+    if not mesh:
+        return None
+    out: dict[str, int] = {}
+    for part in mesh.split(","):
+        k, _, v = part.partition("=")
+        out[k.strip()] = int(v)
+    return out
 
 
 def _str2bool(v: str) -> bool:

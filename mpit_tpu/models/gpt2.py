@@ -45,6 +45,48 @@ def default_attention(q, k, v, *, causal: bool = True):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def cache_update(cache, new, lengths):
+    """Write ``new`` [B, T, H, Dh] into ``cache`` [B, S, H, Dh] at
+    sequence positions ``lengths .. lengths+T-1`` (per-slot start).
+
+    The KV-cache append (ISSUE 4): prefill calls it with ``lengths = 0``
+    (T = padded prompt length — positions past the real prompt are
+    overwritten one-by-one by later decode appends before any attention
+    mask ever exposes them), decode with T = 1 at the slot's current
+    length. Dynamic per-slot starts via a vmapped dynamic_update_slice.
+    """
+
+    def write(c, n, start):
+        return jax.lax.dynamic_update_slice_in_dim(
+            c, n.astype(c.dtype), start, axis=0
+        )
+
+    return jax.vmap(write)(cache, new, lengths)
+
+
+def cached_attention(q, k, v, lengths):
+    """Causal attention of new queries against a padded KV cache.
+
+    ``q`` [B, T, H, Dh] are the T newest positions (global position of
+    row ``t`` is ``lengths + t``); ``k``/``v`` [B, S, H, Dh] are the full
+    cache buffers (new tokens already written via :func:`cache_update`).
+    Key ``j`` is visible to query ``t`` iff ``j <= lengths + t`` — the
+    same causal rule :func:`default_attention` applies, extended over the
+    padded buffer, with the identical einsum/f32-softmax structure so
+    cached and uncached forwards agree numerically (masked keys
+    contribute exact zeros). Heads-local by construction: the TP engine
+    calls this on its H/P head shard unchanged.
+    """
+    dh = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(dh)
+    t_q, s_max = q.shape[1], k.shape[1]
+    q_pos = lengths[:, None] + jnp.arange(t_q)[None, :]  # [B, T]
+    valid = jnp.arange(s_max)[None, None, :] <= q_pos[:, :, None]  # [B,T,S]
+    scores = jnp.where(valid[:, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 @dataclasses.dataclass(frozen=True)
 class GPT2Config:
     vocab_size: int = 50257
@@ -106,13 +148,28 @@ class Block(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, layer_cache=None):
+        """``layer_cache`` (serving): ``(k, v, lengths)`` with k/v
+        [B, S_max, H, Dh] and lengths [B] — the new tokens' K/V are
+        appended at ``lengths`` and attention runs against the cache
+        (:func:`cached_attention`) instead of ``cfg.attention_fn``;
+        returns ``(x, (k, v))`` with the updated buffers. ``None``
+        (training): the historical single-output signature, untouched.
+        """
         cfg = self.cfg
         h = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln1")(x)
         qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda t: t.reshape(*t.shape[:-1], cfg.num_heads, cfg.head_dim)
-        attn = cfg.attention_fn(split(q), split(k), split(v), causal=True)
+        if layer_cache is None:
+            attn = cfg.attention_fn(split(q), split(k), split(v), causal=True)
+            new_cache = None
+        else:
+            k_cache, v_cache, lengths = layer_cache
+            k_cache = cache_update(k_cache, split(k), lengths)
+            v_cache = cache_update(v_cache, split(v), lengths)
+            attn = cached_attention(split(q), k_cache, v_cache, lengths)
+            new_cache = (k_cache, v_cache)
         attn = attn.reshape(*attn.shape[:-2], cfg.d_model)
         x = x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="proj")(attn)
 
@@ -120,14 +177,14 @@ class Block(nn.Module):
         h = nn.Dense(cfg.ff_dim, dtype=cfg.dtype, name="fc")(h)
         h = nn.gelu(h)
         x = x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="out")(h)
-        return x
+        return x if layer_cache is None else (x, new_cache)
 
 
 class GPT2(nn.Module):
     cfg: GPT2Config = GPT2Config()
 
     @nn.compact
-    def __call__(self, tokens, positions=None, targets=None):
+    def __call__(self, tokens, positions=None, targets=None, cache=None):
         """tokens [B, T] int32 → logits [B, T, vocab] float32.
 
         ``positions`` ([T] or [B, T] int32) overrides the default
@@ -140,8 +197,29 @@ class GPT2(nn.Module):
         and returns **per-token losses** [B, T] float32 instead of logits
         — the [B, T, vocab] f32 logits array is never materialized.
         Matmul operand dtype follows ``cfg.head_dtype`` on both paths.
+
+        ``cache`` (serving; :mod:`mpit_tpu.serve`): ``(k, v, lengths)``
+        with k/v ``[num_layers, B, S_max, H, Dh]`` stacked per-layer KV
+        buffers and ``lengths`` [B] int32, the per-slot token count
+        already cached. The T new tokens are appended at ``lengths`` and
+        attended causally against the cache; positions default to
+        ``lengths + arange(T)``; the return becomes ``(logits,
+        (new_k, new_v))``. Prefill = call with ``lengths = 0`` and the
+        padded prompt; decode = call with T = 1. Mutually exclusive with
+        ``targets``.
         """
         cfg = self.cfg
+        if cache is not None:
+            if targets is not None:
+                raise ValueError(
+                    "cache and targets are mutually exclusive: the fused "
+                    "xent head never materializes the logits decode needs"
+                )
+            cache_k, cache_v, cache_lengths = cache
+            if positions is None:
+                positions = cache_lengths[:, None] + jnp.arange(
+                    tokens.shape[-1]
+                )[None, :]
         wte = self.param(
             "wte",
             nn.initializers.normal(0.02),
@@ -160,8 +238,16 @@ class GPT2(nn.Module):
         block = Block
         if cfg.remat:
             block = nn.remat(Block)
+        new_k, new_v = [], []
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"block_{i}")(x)
+            if cache is None:
+                x = block(cfg, name=f"block_{i}")(x)
+            else:
+                x, (k_i, v_i) = block(cfg, name=f"block_{i}")(
+                    x, (cache_k[i], cache_v[i], cache_lengths)
+                )
+                new_k.append(k_i)
+                new_v.append(v_i)
         x = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln_f")(x)
         # LM head (f32 accumulation regardless of operand dtype); tied to
         # wte by default, separate under tie_head=False (see GPT2Config).
@@ -187,6 +273,8 @@ class GPT2(nn.Module):
             head.astype(cfg.head_dtype),
             preferred_element_type=jnp.float32,
         )
+        if cache is not None:
+            return logits, (jnp.stack(new_k), jnp.stack(new_v))
         return logits
 
     @staticmethod

@@ -66,6 +66,7 @@ from mpit_tpu.obs.core import (
     instant,
     local_recorder,
     span,
+    span_at,
     summary,
 )
 from mpit_tpu.obs.export import (
@@ -94,6 +95,7 @@ __all__ = [
     "local_recorder",
     "snapshot_trace_events",
     "span",
+    "span_at",
     "summary",
     "traffic_matrix",
 ]

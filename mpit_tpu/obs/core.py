@@ -48,6 +48,7 @@ __all__ = [
     "instant",
     "local_recorder",
     "span",
+    "span_at",
     "summary",
 ]
 
@@ -358,6 +359,18 @@ def span(name: str, **attrs):
     if _current() is None:
         return _NOOP
     return _Span(name, attrs or None)
+
+
+def span_at(name: str, t0: float, t1: float, **attrs) -> None:
+    """Record a completed span from explicit ``time.perf_counter``
+    timestamps — for intervals that are not a ``with`` block on one
+    thread: the serve scheduler's per-request ``queue_wait`` / TTFT /
+    end-to-end latency intervals span submit→admit→retire across many
+    loop ticks. The summary's per-phase p50/p95 roll-up over such spans
+    is the latency histogram (ISSUE 4)."""
+    rec = _current()
+    if rec is not None:
+        rec.add_span(name, t0, t1, attrs or None)
 
 
 def instant(name: str, **attrs) -> None:

@@ -89,6 +89,14 @@ class Sentinel:
         consecutive steps.
       max_anomalies: cap on retained anomaly records (counts keep
         accumulating past it; the overflow is reported).
+      phases: the monitored metric names (ISSUE 4 satellite). ``None``
+        (default) monitors every metric fed in — the historical
+        behavior, and what ``hardened_loop`` relies on. A tuple
+        restricts detection to those names: the serve scheduler runs
+        the SAME detector on its ``decode``/``prefill`` tick streams
+        with ``phases=("decode", "prefill")``, and observations of any
+        other metric are dropped — one sentinel instance can be handed
+        to several feeders without cross-talk.
     """
 
     def __init__(
@@ -102,6 +110,7 @@ class Sentinel:
         mad_floor_pct: float = 5.0,
         starvation_ratio: float = 0.5,
         max_anomalies: int = 64,
+        phases: tuple[str, ...] | None = None,
     ):
         self.window = window
         self.warmup = max(2, warmup)
@@ -111,6 +120,7 @@ class Sentinel:
         self.mad_floor_pct = mad_floor_pct
         self.starvation_ratio = starvation_ratio
         self.max_anomalies = max_anomalies
+        self.phases = tuple(phases) if phases is not None else None
         self._detectors: dict[str, _Detector] = {}
         self._anomalies: list[dict] = []
         self._counts: dict[str, int] = {}
@@ -128,7 +138,11 @@ class Sentinel:
         _obs.instant("anomaly", **record)
 
     def observe(self, metric: str, step: int, value: float) -> None:
-        """Feed one observation of ``metric`` (seconds) at ``step``."""
+        """Feed one observation of ``metric`` (seconds) at ``step``.
+        Ignored when a ``phases`` tuple is configured and doesn't name
+        ``metric``."""
+        if self.phases is not None and metric not in self.phases:
+            return
         det = self._detectors.get(metric)
         if det is None:
             det = self._detectors[metric] = _Detector(self.window)
@@ -183,6 +197,17 @@ class Sentinel:
             det.above_streak = 0
         det.push(value)
 
+    def observe_phases(self, tick: int, **values: float) -> None:
+        """Feed several named phase durations for one tick — the
+        metric-agnostic counterpart of :meth:`observe_step` (the serve
+        scheduler calls ``observe_phases(tick, decode=..., prefill=...)``
+        per loop iteration). ``None`` values are skipped; the ``phases``
+        filter applies per name. (Positional is named ``tick``, not
+        ``step``, so "step" itself stays usable as a phase kwarg.)"""
+        for name, value in values.items():
+            if value is not None:
+                self.observe(name, tick, value)
+
     def observe_step(
         self,
         step: int,
@@ -207,6 +232,8 @@ class Sentinel:
         if prefetch_wait_s is None:
             return
         self.observe("prefetch_wait", step, prefetch_wait_s)
+        if self.phases is not None and "prefetch_wait" not in self.phases:
+            return  # starvation is the prefetch_wait metric's verdict
         denom = (
             iteration_s if iteration_s is not None
             else step_s + prefetch_wait_s

@@ -1,0 +1,53 @@
+"""mpit_tpu.serve — TPU-native continuous-batching inference (ISSUE 4).
+
+The reference's pserver is a request-serving loop — receive a tagged
+message, act on shared state, reply (SURVEY.md §3.2 A1). Training
+collapsed that protocol into SPMD steps (``mpit_tpu.train``); serving
+re-grows it as the north star demands ("serves heavy traffic"): a
+batched GPT-2 inference engine where the shared state is a preallocated
+per-slot KV cache and the request loop is continuous batching.
+
+- :mod:`~mpit_tpu.serve.kvcache` — ``[layers, slots, max_len, heads,
+  head_dim]`` K/V buffers + per-slot lengths; head-dim sharding specs
+  for tensor parallelism.
+- :mod:`~mpit_tpu.serve.engine` — ONE jitted prefill step + ONE jitted
+  decode step over the whole slot batch (fixed shapes, two compiles for
+  the engine's lifetime); per-slot greedy/temperature/top-k sampling
+  jitted with the step; a TP variant reusing the ``parallel.megatron``
+  block rules. Greedy outputs bit-match the no-cache ``models.gpt2``
+  forward.
+- :mod:`~mpit_tpu.serve.scheduler` — the continuous-batching loop:
+  queue → admit into freed slots between decode ticks → per-slot
+  retirement (EOS / max tokens / cache full), with full ``obs``
+  integration (prefill/decode spans, per-request queue-wait/TTFT/
+  latency intervals, slot-occupancy gauge).
+- :mod:`~mpit_tpu.serve.weights` — dense-checkpoint ingestion: a
+  ``train.convert --save-dense`` ``.npz`` from ANY training tier serves
+  directly (leaf contract pinned in ``tests/test_convert.py``).
+
+CLI: ``python -m mpit_tpu.serve`` — load a dense checkpoint (or
+random-init), serve a synthetic request stream, print the obs summary.
+"""
+
+from mpit_tpu.serve.engine import Engine, sample_tokens
+from mpit_tpu.serve.kvcache import KVCache, alloc_cache, cache_specs
+from mpit_tpu.serve.scheduler import Completed, Request, Server
+from mpit_tpu.serve.weights import (
+    expected_param_shapes,
+    infer_config,
+    load_gpt2_params,
+)
+
+__all__ = [
+    "Completed",
+    "Engine",
+    "KVCache",
+    "Request",
+    "Server",
+    "alloc_cache",
+    "cache_specs",
+    "expected_param_shapes",
+    "infer_config",
+    "load_gpt2_params",
+    "sample_tokens",
+]

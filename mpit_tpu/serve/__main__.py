@@ -1,0 +1,159 @@
+"""Serving entry point: ``python -m mpit_tpu.serve [options]``.
+
+Loads a trained dense checkpoint (``--ckpt state.npz``, the
+``train.convert --save-dense`` format) or random-inits a model
+(``--model tiny|small``), serves a synthetic request stream through the
+continuous-batching engine, and prints one JSON result: the serving
+stats (tokens/s, TTFT and latency percentiles, occupancy) plus the obs
+phase summary. ``--mesh model=2`` selects the tensor-parallel engine.
+
+Config follows the ``asyncsgd.config`` pattern: one dataclass, argparse
+generated from its fields.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+import numpy as np
+
+from mpit_tpu.asyncsgd.config import from_argv
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Options for the serving CLI (the ``opt`` table analogue)."""
+
+    ckpt: str = ""  # dense .npz from --save-dense ("" = random init)
+    model: str = "tiny"  # random-init size: tiny | small
+    num_heads: int = 0  # ckpt head-count override (0 = d_model//64)
+    slots: int = 4  # concurrent KV-cache slots
+    max_len: int = 96  # per-slot cache length (prompt + generation)
+    prefill_len: int = 32  # padded prompt buffer width
+    requests: int = 16  # synthetic stream size
+    prompt_len: int = 8  # max synthetic prompt length (uniform 1..N)
+    max_new_tokens: int = 16
+    temperature: float = 0.0  # <=0 greedy
+    top_k: int = 0  # 0 = full vocab
+    mesh: str = ""  # e.g. "model=2" -> TP engine over that axis
+    sentinel: bool = False  # decode/prefill tick anomaly sentinel
+    trace: str = ""  # write a Chrome trace of the run here
+    seed: int = 0
+
+    def mesh_shape(self) -> dict[str, int] | None:
+        from mpit_tpu.asyncsgd.config import parse_mesh
+
+        return parse_mesh(self.mesh)
+
+
+def _build_engine(cfg: ServeConfig):
+    import jax
+    import jax.numpy as jnp
+
+    import mpit_tpu
+    from mpit_tpu.models import GPT2, GPT2Config
+    from mpit_tpu.serve import Engine, load_gpt2_params
+
+    world, tp_axis = None, None
+    shape = cfg.mesh_shape()
+    if shape:
+        world = mpit_tpu.init(shape, set_default=False)
+        tp_axis = "model" if "model" in shape else next(iter(shape))
+
+    if cfg.ckpt:
+        params, mcfg = load_gpt2_params(cfg.ckpt, num_heads=cfg.num_heads)
+    else:
+        mcfg = (
+            GPT2Config.small()
+            if cfg.model == "small"
+            else GPT2Config.tiny(max_seq_len=max(cfg.max_len, 128))
+        )
+        params = jax.jit(GPT2(mcfg).init)(
+            jax.random.key(cfg.seed), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+    engine = Engine(
+        mcfg,
+        params,
+        slots=cfg.slots,
+        max_len=cfg.max_len,
+        prefill_len=cfg.prefill_len,
+        world=world,
+        tp_axis=tp_axis,
+        seed=cfg.seed,
+    )
+    return engine, mcfg
+
+
+def synthetic_requests(cfg: ServeConfig, vocab_size: int):
+    """A reproducible request stream: uniform prompt lengths 1..N,
+    uniform token ids, the CLI's sampling settings."""
+    from mpit_tpu.serve import Request
+
+    rng = np.random.RandomState(cfg.seed)
+    for i in range(cfg.requests):
+        plen = int(rng.randint(1, cfg.prompt_len + 1))
+        yield Request(
+            rid=i,
+            prompt=rng.randint(0, vocab_size, size=plen).tolist(),
+            max_new_tokens=cfg.max_new_tokens,
+            temperature=cfg.temperature,
+            top_k=cfg.top_k,
+        )
+
+
+def main(argv: list[str] | None = None) -> dict:
+    cfg = from_argv(ServeConfig, argv, prog="python -m mpit_tpu.serve")
+    from mpit_tpu import obs
+    from mpit_tpu.serve import Server
+
+    rec = obs.enable(obs.Recorder())
+    sentinel = (
+        obs.Sentinel(phases=("decode", "prefill"), warmup=4)
+        if cfg.sentinel
+        else None
+    )
+    engine, mcfg = _build_engine(cfg)
+    server = Server(engine, sentinel=sentinel)
+    for req in synthetic_requests(cfg, mcfg.vocab_size):
+        server.submit(req)
+    t0 = time.perf_counter()
+    server.run()
+    wall = time.perf_counter() - t0
+
+    summ = rec.summary()
+    stats = server.stats()
+    decode_s = summ["phases"].get("decode", {}).get("total_s", 0.0)
+    gen = stats["generated_tokens"]
+    # First tokens come from prefill; decode throughput counts the rest.
+    decode_tokens = gen - stats["requests_completed"]
+    out = {
+        "model": {
+            "layers": mcfg.num_layers,
+            "d_model": mcfg.d_model,
+            "vocab": mcfg.vocab_size,
+            "source": cfg.ckpt or f"random-init {cfg.model}",
+        },
+        "wall_s": round(wall, 4),
+        "decode_tokens_per_sec": (
+            round(decode_tokens / decode_s, 2) if decode_s else None
+        ),
+        **stats,
+        "obs_summary": {
+            name: {k: round(v, 6) for k, v in p.items()}
+            for name, p in summ["phases"].items()
+        },
+    }
+    if sentinel is not None:
+        out["sentinel"] = sentinel.report()
+    if cfg.trace:
+        obs.export_chrome_trace(cfg.trace, recorder=rec)
+        out["trace"] = cfg.trace
+    obs.disable()
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(main(sys.argv[1:])))

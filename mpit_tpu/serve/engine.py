@@ -1,0 +1,346 @@
+"""Batched GPT-2 inference engine: ONE jitted prefill + ONE jitted decode.
+
+The execution contract (ISSUE 4 tentpole):
+
+- **Fixed shapes, no per-request recompiles.** Both steps run over the
+  whole slot batch — prefill on ``[slots, prefill_len]`` padded prompts
+  with a per-slot admit mask (non-admitted slots compute and are
+  discarded by ``jnp.where``; the FLOP waste buys exactly two compiled
+  programs for the engine's whole lifetime), decode on ``[slots, 1]``.
+- **Prefill writes the cache** from position 0 of each admitted slot and
+  samples the request's FIRST output token from the logits at
+  ``prompt_len - 1``; **decode appends one token** per active slot at
+  its current length. Greedy outputs bit-match the no-cache
+  ``models.gpt2`` forward (parity-pinned in ``tests/test_serve.py``):
+  the cached attention is the same einsum/f32-softmax computation with
+  masked cache rows contributing exact zeros.
+- **Sampling is jitted with the step**: per-slot greedy / temperature /
+  top-k arrays, so heterogeneous requests batch together.
+
+Tensor parallelism: ``Engine(..., world=w, tp_axis="model")`` swaps the
+flax forward for a hand-placed shard_map forward that reuses the
+``parallel.megatron`` block rules — column-parallel qkv/fc,
+row-parallel proj/out closing on a psum, ``repack_qkv`` for contiguous
+head shards, ``tp_block_specs`` for the param placement — with the KV
+cache sharded on the head dim (``kvcache.cache_specs``). Embeddings and
+the LM head stay replicated (decode is latency-bound on the blocks; the
+head matmul at T=1 is negligible).
+
+Host surface: :meth:`Engine.prefill` / :meth:`Engine.decode` — the
+scheduler (``serve.scheduler``) owns queueing, retirement and
+observability around them.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpit_tpu.models.gpt2 import (
+    GPT2,
+    GPT2Config,
+    cache_update,
+    cached_attention,
+)
+from mpit_tpu.serve.kvcache import KVCache, alloc_cache, cache_specs
+
+__all__ = ["Engine", "sample_tokens"]
+
+
+def sample_tokens(logits, key, temperature, top_k):
+    """Per-slot sampling over ``logits`` [S, V] (float32).
+
+    ``temperature`` [S] float32 — ``<= 0`` selects greedy (argmax) for
+    that slot; ``top_k`` [S] int32 — ``> 0`` restricts sampling to the
+    k highest-logit tokens (per slot; 0 = full vocab). All slots draw
+    from one key (jax.random.categorical is row-independent noise).
+    """
+    vocab = logits.shape[-1]
+    greedy = temperature <= 0.0
+    # Per-slot top-k: threshold at each slot's k-th largest logit.
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(top_k - 1, 0, vocab - 1)
+    thresh = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    masked = jnp.where(
+        (top_k[:, None] > 0) & (logits < thresh), -jnp.inf, logits
+    )
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, masked / temp, axis=-1)
+    return jnp.where(
+        greedy, jnp.argmax(logits, axis=-1), sampled
+    ).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# TP forward (shard_map body): megatron block rules + head-sharded cache
+# ---------------------------------------------------------------------------
+
+
+def _tp_cache_forward(params, tokens, cache: KVCache, *, cfg, axis):
+    """Cache-aware GPT-2 forward INSIDE shard_map over the TP axis.
+
+    The per-device view: block matmul kernels arrive sharded per
+    ``megatron.tp_block_specs`` (qkv in ``repack_qkv`` layout), the KV
+    cache carries this device's H/P heads, embeddings/LayerNorms/head
+    replicated. Numerics mirror ``models.gpt2`` block-for-block —
+    ``megatron.layernorm`` is the parity-tested nn.LayerNorm
+    equivalent; each half closes on a psum (row-parallel proj/out).
+    Returns replicated logits + this device's updated cache shard.
+    """
+    from jax import lax
+
+    from mpit_tpu.parallel import megatron as M
+
+    p = lax.axis_size(axis)
+    heads_local = cfg.num_heads // p
+    t = tokens.shape[-1]
+    positions = cache.lengths[:, None] + jnp.arange(t)[None, :]
+    x = params["wte"][tokens].astype(cfg.dtype) + params["wpe"][
+        positions
+    ].astype(cfg.dtype)
+
+    dt = cfg.dtype
+    split = lambda a: a.reshape(*a.shape[:-1], heads_local, cfg.head_dim)
+    new_k, new_v = [], []
+    for i in range(cfg.num_layers):
+        blk = params[f"block_{i}"]
+        h = M.layernorm(x, blk["ln1"]["scale"], blk["ln1"]["bias"]).astype(dt)
+        qkv = M.column_parallel_dense(
+            h, blk["qkv"]["kernel"].astype(dt), blk["qkv"]["bias"].astype(dt)
+        )
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        k_i = cache_update(cache.k[i], split(k), cache.lengths)
+        v_i = cache_update(cache.v[i], split(v), cache.lengths)
+        attn = cached_attention(split(q), k_i, v_i, cache.lengths)
+        attn = attn.reshape(*attn.shape[:-2], -1)
+        x = x + M.row_parallel_dense(
+            attn,
+            blk["proj"]["kernel"].astype(dt),
+            blk["proj"]["bias"].astype(dt),
+            axis=axis,
+        )
+        h = M.layernorm(x, blk["ln2"]["scale"], blk["ln2"]["bias"]).astype(dt)
+        h = jax.nn.gelu(
+            M.column_parallel_dense(
+                h, blk["fc"]["kernel"].astype(dt), blk["fc"]["bias"].astype(dt)
+            )
+        )
+        x = x + M.row_parallel_dense(
+            h,
+            blk["out"]["kernel"].astype(dt),
+            blk["out"]["bias"].astype(dt),
+            axis=axis,
+        )
+        new_k.append(k_i)
+        new_v.append(v_i)
+
+    x = M.layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    head = params.get("head", params["wte"])
+    logits = jnp.einsum(
+        "btd,vd->btv",
+        x.astype(cfg.head_dtype),
+        head.astype(cfg.head_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, KVCache(
+        k=jnp.stack(new_k), v=jnp.stack(new_v), lengths=cache.lengths
+    )
+
+
+def _tp_param_specs(cfg, params, axis: str):
+    """Spec tree mirroring a dense GPT-2 param tree: ``tp_block_specs``
+    per block, everything else replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from mpit_tpu.parallel.megatron import tp_block_specs
+
+    specs: dict[str, Any] = {
+        k: jax.tree.map(lambda _: P(), v)
+        for k, v in params.items()
+        if not str(k).startswith("block_")
+    }
+    for i in range(cfg.num_layers):
+        specs[f"block_{i}"] = tp_block_specs(axis)
+    return specs
+
+
+class Engine:
+    """Slot-batched KV-cache inference over one GPT-2 param tree.
+
+    Device state lives on the engine (cache + per-slot last token);
+    ``active``/sampling arrays are passed per call by the scheduler.
+    ``world``/``tp_axis`` select the tensor-parallel variant; params are
+    placed (and qkv repacked) at construction, so per-step host traffic
+    is the slot-width control arrays only.
+    """
+
+    def __init__(
+        self,
+        cfg: GPT2Config,
+        params,
+        *,
+        slots: int = 8,
+        max_len: int | None = None,
+        prefill_len: int | None = None,
+        world=None,
+        tp_axis: str | None = None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.slots = slots
+        self.max_len = min(max_len or cfg.max_seq_len, cfg.max_seq_len)
+        self.prefill_len = min(prefill_len or self.max_len, self.max_len)
+        self.tp_axis = tp_axis
+        self._key = jax.random.key(seed)
+
+        sharding = None
+        if tp_axis is not None:
+            if world is None:
+                raise ValueError("tp_axis requires a World")
+            from mpit_tpu.parallel.megatron import repack_qkv
+
+            p = world.axis_size(tp_axis)
+            if cfg.num_heads % p:
+                raise ValueError(
+                    f"num_heads ({cfg.num_heads}) must divide TP={p}"
+                )
+            params = {
+                k: repack_qkv(v, p) if str(k).startswith("block_") else v
+                for k, v in params.items()
+            }
+            self._specs = _tp_param_specs(cfg, params, tp_axis)
+            params = jax.device_put(
+                params,
+                jax.tree.map(
+                    lambda s: world.sharding(*s), self._specs,
+                    is_leaf=lambda s: isinstance(
+                        s, jax.sharding.PartitionSpec
+                    ),
+                ),
+            )
+            cs = cache_specs(tp_axis)
+            sharding = world.sharding(*cs.k)
+            fwd = world.shard_map(
+                functools.partial(_tp_cache_forward, cfg=cfg, axis=tp_axis),
+                in_specs=(self._specs, jax.sharding.PartitionSpec(), cs),
+                out_specs=(jax.sharding.PartitionSpec(), cs),
+            )
+        else:
+            model = GPT2(cfg)
+
+            def fwd(prms, tokens, cache: KVCache):
+                logits, (k2, v2) = model.apply(
+                    {"params": prms},
+                    tokens,
+                    cache=(cache.k, cache.v, cache.lengths),
+                )
+                return logits, KVCache(k=k2, v=v2, lengths=cache.lengths)
+
+        self.params = params
+        self.cache = alloc_cache(
+            cfg, slots, self.max_len, sharding=sharding
+        )
+        self.last_token = jnp.zeros((slots,), jnp.int32)
+        self._forward = fwd
+        self._prefill_jit = jax.jit(self._prefill_step)
+        self._decode_jit = jax.jit(self._decode_step)
+
+    # -- jitted step bodies -------------------------------------------------
+    def _prefill_step(
+        self, params, cache, last, tokens, prompt_lens, admit, key, temp, topk
+    ):
+        """Whole-slot-batch prefill: every slot computes on the padded
+        [slots, prefill_len] buffer from position 0; only admitted
+        slots' cache writes / length resets / first tokens stick."""
+        fresh = KVCache(
+            k=cache.k, v=cache.v, lengths=jnp.zeros_like(cache.lengths)
+        )
+        logits, new = self._forward(params, tokens, fresh)
+        first = jnp.take_along_axis(
+            logits,
+            jnp.maximum(prompt_lens - 1, 0)[:, None, None],
+            axis=1,
+        )[:, 0].astype(jnp.float32)
+        tok = sample_tokens(first, key, temp, topk)
+        sel = admit[None, :, None, None, None]
+        return (
+            KVCache(
+                k=jnp.where(sel, new.k, cache.k),
+                v=jnp.where(sel, new.v, cache.v),
+                lengths=jnp.where(admit, prompt_lens, cache.lengths),
+            ),
+            jnp.where(admit, tok, last),
+        )
+
+    def _decode_step(self, params, cache, last, active, key, temp, topk):
+        """One decode tick: append each active slot's last token at its
+        current length, sample the next from the new final logits."""
+        logits, new = self._forward(params, last[:, None], cache)
+        tok = sample_tokens(
+            logits[:, -1].astype(jnp.float32), key, temp, topk
+        )
+        sel = active[None, :, None, None, None]
+        return (
+            KVCache(
+                k=jnp.where(sel, new.k, cache.k),
+                v=jnp.where(sel, new.v, cache.v),
+                lengths=jnp.where(
+                    active, cache.lengths + 1, cache.lengths
+                ),
+            ),
+            jnp.where(active, tok, last),
+        )
+
+    # -- host surface (the scheduler's API) ---------------------------------
+    def _split(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def prefill(self, tokens, prompt_lens, admit, temp, topk) -> np.ndarray:
+        """Admit requests: ``tokens`` [slots, prefill_len] int32 (padded),
+        ``prompt_lens``/``admit``/``temp``/``topk`` [slots]. Returns the
+        per-slot last token (the first OUTPUT token for admitted slots)
+        as host numpy — the fetch is the step's completion fence."""
+        self.cache, self.last_token = self._prefill_jit(
+            self.params,
+            self.cache,
+            self.last_token,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(prompt_lens, jnp.int32),
+            jnp.asarray(admit, bool),
+            self._split(),
+            jnp.asarray(temp, jnp.float32),
+            jnp.asarray(topk, jnp.int32),
+        )
+        return np.asarray(self.last_token)
+
+    def decode(self, active, temp, topk) -> np.ndarray:
+        """One decode tick over the slot batch; returns the per-slot
+        next token (host numpy; stale for inactive slots)."""
+        self.cache, self.last_token = self._decode_jit(
+            self.params,
+            self.cache,
+            self.last_token,
+            jnp.asarray(active, bool),
+            self._split(),
+            jnp.asarray(temp, jnp.float32),
+            jnp.asarray(topk, jnp.int32),
+        )
+        return np.asarray(self.last_token)
+
+    def lengths(self) -> np.ndarray:
+        return np.asarray(self.cache.lengths)
+
+    def reset(self, seed: int = 0) -> None:
+        """Clear all slots (bench warmup path); compiled steps survive."""
+        self.cache = KVCache(
+            k=jnp.zeros_like(self.cache.k),
+            v=jnp.zeros_like(self.cache.v),
+            lengths=jnp.zeros_like(self.cache.lengths),
+        )
+        self.last_token = jnp.zeros_like(self.last_token)
+        self._key = jax.random.key(seed)

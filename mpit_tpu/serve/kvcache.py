@@ -1,0 +1,102 @@
+"""Preallocated per-slot KV cache for continuous-batching decode.
+
+The serving engine (ISSUE 4) never reshapes per request: one fixed
+``[num_layers, slots, max_len, heads, head_dim]`` K and V buffer pair is
+allocated up front, requests are *admitted into slots*, and every jitted
+step runs over the whole slot batch. Layout rationale:
+
+- layers lead so the per-layer view ``cache.k[i]`` hands each
+  transformer block a ``[slots, max_len, H, Dh]`` buffer — exactly the
+  sequence-major ``[B, T, H, Dh]`` layout
+  :func:`mpit_tpu.models.gpt2.default_attention` (and the flash/ring
+  kernels) already use;
+- slots are the batch dim: admission/retirement is a per-slot mask, no
+  data movement — a freed slot's stale rows are simply overwritten by
+  the next prefill (`jnp.where` on the slot dim selects whose writes
+  stick);
+- ``lengths`` [slots] int32 is the single source of truth for both the
+  append position (:func:`mpit_tpu.models.gpt2.cache_update` writes at
+  ``lengths``) and the attention visibility mask (key ``j`` visible iff
+  ``j <= lengths + t``) — a slot's history can never leak into another
+  request because the mask, not the buffer contents, defines validity.
+
+Under tensor parallelism the head dim shards over the TP axis
+(:func:`cache_specs`) — each device holds its H/P heads' cache, matching
+the Megatron column-sharded qkv layout (``parallel.megatron``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["KVCache", "alloc_cache", "cache_specs"]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class KVCache:
+    """The engine's decode state: KV buffers + per-slot fill counts.
+
+    ``k``/``v``: [num_layers, slots, max_len, heads, head_dim];
+    ``lengths``: [slots] int32, tokens currently cached per slot.
+    A pytree, so it passes through jit/shard_map boundaries whole.
+    """
+
+    k: Any
+    v: Any
+    lengths: Any
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    @property
+    def slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def alloc_cache(
+    cfg,
+    slots: int,
+    max_len: int,
+    *,
+    dtype=None,
+    sharding=None,
+) -> KVCache:
+    """Allocate the zeroed cache for ``slots`` concurrent requests.
+
+    ``dtype`` defaults to the model's activation dtype (``cfg.dtype``) —
+    the K/V written by the blocks arrive in it. ``sharding``: optional
+    ``NamedSharding`` for the buffers (the TP engine passes the
+    head-sharded one from :func:`cache_specs`).
+    """
+    shape = (cfg.num_layers, slots, max_len, cfg.num_heads, cfg.head_dim)
+    dt = dtype or cfg.dtype
+    kw = {"device": sharding} if sharding is not None else {}
+    return KVCache(
+        k=jnp.zeros(shape, dt, **kw),
+        v=jnp.zeros(shape, dt, **kw),
+        lengths=jnp.zeros((slots,), jnp.int32),
+    )
+
+
+def cache_specs(axis: str = "model") -> KVCache:
+    """PartitionSpecs for a :class:`KVCache` under tensor parallelism:
+    K/V sharded on the HEAD dim (axis 3 of [L, S, T, H, Dh]) — each TP
+    rank caches exactly its column-sharded qkv heads — lengths
+    replicated. Shaped as a KVCache so it drops into shard_map
+    ``in_specs``/``out_specs`` positionally."""
+    kv = P(None, None, None, axis, None)
+    return KVCache(k=kv, v=kv, lengths=P())

@@ -1,0 +1,263 @@
+"""Continuous batching: the request loop over the slot-batched engine.
+
+The reference's pserver is a tag-dispatched request-serving loop
+(SURVEY.md §3.2 A1) — receive, act, reply, forever. This is that
+capability rebuilt for inference: requests queue on the host, are
+admitted into freed KV-cache slots BETWEEN decode ticks (no tick waits
+for a full batch — a new request rides the next prefill while everyone
+else keeps decoding), and retire per-slot on EOS / max-new-tokens /
+cache-full, freeing the slot for the next queue entry immediately.
+
+Observability (``mpit_tpu.obs``) is first-class, not bolted on:
+
+- spans: ``prefill`` (per admission batch) and ``decode`` (per tick) —
+  both close on the host fetch of the sampled tokens, so their wall
+  clock covers real device completion;
+- per-request intervals recorded with explicit timestamps
+  (``obs.span_at``): ``queue_wait`` (submit → admit), ``request_ttft``
+  (submit → first token) and ``request_latency`` (submit → retire) —
+  the summary's per-phase p50/p95 roll-up then IS the latency/TTFT
+  histogram, and the Chrome trace shows every request as a bar;
+- ``slot_occupancy`` gauge + ``serve_tokens``/``serve_requests``
+  counters each tick.
+
+An optional :class:`mpit_tpu.obs.Sentinel` (``phases=("decode",
+"prefill")``) watches the tick stream for spikes/sustained degradation
+— the serving analogue of the training loop's step-wall sentinel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+from mpit_tpu import obs
+
+__all__ = ["Request", "Completed", "Server"]
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``temperature <= 0`` = greedy;
+    ``top_k = 0`` = full vocab; ``eos_id = None`` = never stop early."""
+
+    rid: Any
+    prompt: list[int]
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    eos_id: int | None = None
+
+
+@dataclasses.dataclass
+class Completed:
+    """A finished request: output + the latency facts the histograms
+    aggregate. ``tokens`` includes the EOS token when one stopped it."""
+
+    rid: Any
+    prompt: list[int]
+    tokens: list[int]
+    submit_t: float
+    first_token_t: float
+    finish_t: float
+    truncated: bool = False  # retired by cache-full, not EOS/max-tokens
+
+    @property
+    def ttft_s(self) -> float:
+        return self.first_token_t - self.submit_t
+
+    @property
+    def latency_s(self) -> float:
+        return self.finish_t - self.submit_t
+
+
+@dataclasses.dataclass
+class _Live:
+    req: Request
+    submit_t: float
+    first_token_t: float = 0.0
+    tokens: list = dataclasses.field(default_factory=list)
+
+
+class Server:
+    """The continuous-batching loop around one :class:`~mpit_tpu.serve.Engine`.
+
+    Host-side only: slot bookkeeping, the request queue, retirement and
+    telemetry. ``submit()`` enqueues; ``run()`` drives admit/decode
+    ticks until the queue and all slots drain (or ``max_ticks``).
+    """
+
+    def __init__(self, engine, *, sentinel=None):
+        self.engine = engine
+        self.sentinel = sentinel
+        self.queue: deque[_Live] = deque()
+        self.live: dict[int, _Live] = {}  # slot -> in-flight request
+        self.free: list[int] = list(range(engine.slots))[::-1]  # pop() = slot 0 first
+        self.completed: list[Completed] = []
+        self.tick = 0
+        self.admissions = 0
+        self._occupancy_sum = 0.0
+        # Per-slot sampling-control arrays (host; refreshed on admit/retire).
+        s = engine.slots
+        self._temp = np.zeros((s,), np.float32)
+        self._topk = np.zeros((s,), np.int32)
+
+    # -- intake -------------------------------------------------------------
+    def submit(self, req: Request) -> None:
+        if not req.prompt:
+            raise ValueError(f"request {req.rid!r}: empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"request {req.rid!r}: max_new_tokens must be >= 1 "
+                f"(prefill always samples the first token), got "
+                f"{req.max_new_tokens}"
+            )
+        if len(req.prompt) > self.engine.prefill_len:
+            raise ValueError(
+                f"request {req.rid!r}: prompt length {len(req.prompt)} > "
+                f"engine prefill_len {self.engine.prefill_len}"
+            )
+        if len(req.prompt) + req.max_new_tokens > self.engine.max_len:
+            raise ValueError(
+                f"request {req.rid!r}: prompt + max_new_tokens "
+                f"({len(req.prompt)} + {req.max_new_tokens}) exceeds the "
+                f"engine's max_len {self.engine.max_len}"
+            )
+        self.queue.append(_Live(req, time.perf_counter()))
+
+    # -- the loop -----------------------------------------------------------
+    def _admit(self) -> None:
+        """Move queued requests into free slots and prefill them (one
+        batched call however many were admitted this tick)."""
+        if not self.queue or not self.free:
+            return
+        s, plen = self.engine.slots, self.engine.prefill_len
+        tokens = np.zeros((s, plen), np.int32)
+        lens = np.ones((s,), np.int32)
+        admit = np.zeros((s,), bool)
+        batch: list[tuple[int, _Live]] = []
+        now = time.perf_counter()
+        while self.queue and self.free:
+            live = self.queue.popleft()
+            slot = self.free.pop()
+            p = live.req.prompt
+            tokens[slot, : len(p)] = p
+            lens[slot] = len(p)
+            admit[slot] = True
+            self._temp[slot] = live.req.temperature
+            self._topk[slot] = live.req.top_k
+            obs.span_at("queue_wait", live.submit_t, now, rid=live.req.rid)
+            batch.append((slot, live))
+        with obs.span("prefill", admitted=len(batch)):
+            first = self.engine.prefill(
+                tokens, lens, admit, self._temp, self._topk
+            )
+        t_first = time.perf_counter()
+        self.admissions += len(batch)
+        if self.sentinel is not None:
+            self.sentinel.observe_phases(
+                self.tick, prefill=t_first - now
+            )
+        for slot, live in batch:
+            live.first_token_t = t_first
+            live.tokens = [int(first[slot])]
+            obs.span_at(
+                "request_ttft", live.submit_t, t_first, rid=live.req.rid
+            )
+            self.live[slot] = live
+            self._maybe_retire(slot, t_first)
+
+    def _maybe_retire(self, slot: int, now: float) -> None:
+        """Retire ``slot`` if its newest token finished the request."""
+        live = self.live[slot]
+        req = live.req
+        tok = live.tokens[-1]
+        # Host mirror of the device cache fill: prefill cached the prompt,
+        # each decode tick appends ONE token (the newest sampled token is
+        # not yet written). The next decode would write at this position —
+        # at max_len the slot must retire or it would overrun the buffer.
+        cache_len = len(req.prompt) + len(live.tokens) - 1
+        full = cache_len >= self.engine.max_len
+        done = (
+            (req.eos_id is not None and tok == req.eos_id)
+            or len(live.tokens) >= req.max_new_tokens
+            or full
+        )
+        if not done:
+            return
+        del self.live[slot]
+        self.free.append(slot)
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        obs.span_at("request_latency", live.submit_t, now, rid=req.rid)
+        obs.counter("serve_requests")
+        self.completed.append(
+            Completed(
+                rid=req.rid,
+                prompt=list(req.prompt),
+                tokens=list(live.tokens),
+                submit_t=live.submit_t,
+                first_token_t=live.first_token_t,
+                finish_t=now,
+                truncated=full
+                and tok != req.eos_id
+                and len(live.tokens) < req.max_new_tokens,
+            )
+        )
+
+    def _decode_tick(self) -> None:
+        active = np.zeros((self.engine.slots,), bool)
+        for slot in self.live:
+            active[slot] = True
+        t0 = time.perf_counter()
+        with obs.span("decode", active=int(active.sum())):
+            toks = self.engine.decode(active, self._temp, self._topk)
+        now = time.perf_counter()
+        if self.sentinel is not None:
+            self.sentinel.observe_phases(self.tick, decode=now - t0)
+        obs.counter("serve_tokens", float(active.sum()))
+        for slot in list(self.live):
+            self.live[slot].tokens.append(int(toks[slot]))
+            self._maybe_retire(slot, now)
+
+    def run(self, *, max_ticks: int = 1_000_000) -> list[Completed]:
+        """Drive admit/decode until everything submitted has completed
+        (then return ALL completions so far, in finish order)."""
+        while (self.queue or self.live) and self.tick < max_ticks:
+            self._admit()
+            occupancy = len(self.live) / self.engine.slots
+            self._occupancy_sum += occupancy
+            obs.gauge("slot_occupancy", occupancy)
+            if self.live:
+                self._decode_tick()
+            self.tick += 1
+        return self.completed
+
+    # -- reporting ----------------------------------------------------------
+    def stats(self) -> dict:
+        """Host-side serving roll-up (the obs summary carries the
+        span-derived histograms; this is the request-math view)."""
+        done = self.completed
+        out = {
+            "requests_completed": len(done),
+            "ticks": self.tick,
+            "admissions": self.admissions,
+            "generated_tokens": sum(len(c.tokens) for c in done),
+            "occupancy_mean": round(
+                self._occupancy_sum / max(self.tick, 1), 4
+            ),
+        }
+        if done:
+            lat = np.asarray([c.latency_s for c in done])
+            ttft = np.asarray([c.ttft_s for c in done])
+            out.update(
+                latency_p50_s=round(float(np.percentile(lat, 50)), 6),
+                latency_p95_s=round(float(np.percentile(lat, 95)), 6),
+                ttft_p50_s=round(float(np.percentile(ttft, 50)), 6),
+                ttft_p95_s=round(float(np.percentile(ttft, 95)), 6),
+            )
+        return out

@@ -1,0 +1,127 @@
+"""Checkpoint ingestion: trained dense state → servable params.
+
+The geometry-free dense ``.npz`` (``train/convert.py``: every training
+tier exports to it via ``--save-dense``/``dense_from_*``) is the serving
+input format — a checkpoint trained on any mesh serves directly, no
+conversion job in between. This module owns the contract's consumer
+side:
+
+- :func:`expected_param_shapes` — THE pinned leaf-name/shape map the
+  loader consumes (``tests/test_convert.py`` round-trips a dense export
+  against it, so silent format drift in either direction fails a test);
+- :func:`load_gpt2_params` — read the ``.npz``, validate against the
+  contract, return ``(params, cfg)`` ready for
+  :class:`mpit_tpu.serve.Engine`;
+- :func:`infer_config` — reconstruct the :class:`GPT2Config` geometry
+  from the param tree itself (vocab/max_seq_len/layers/d_model/d_ff and
+  head-tying are all shape-derivable; ``num_heads`` is not — it must be
+  supplied, defaulting to GPT-2's d_model/64 convention).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from mpit_tpu.models.gpt2 import GPT2Config
+
+__all__ = ["expected_param_shapes", "infer_config", "load_gpt2_params"]
+
+
+def expected_param_shapes(cfg: GPT2Config) -> dict[str, tuple[int, ...]]:
+    """``{leaf_path: shape}`` for a dense GPT-2 param tree — the serve
+    loader's input contract. Paths are ``/``-joined (the
+    ``train.convert.save_dense`` on-disk key layout)."""
+    d, ff, v = cfg.d_model, cfg.ff_dim, cfg.vocab_size
+    out: dict[str, tuple[int, ...]] = {
+        "wte": (v, d),
+        "wpe": (cfg.max_seq_len, d),
+        "ln_f/scale": (d,),
+        "ln_f/bias": (d,),
+    }
+    if not cfg.tie_head:
+        out["head"] = (v, d)
+    per_block = {
+        "ln1/scale": (d,), "ln1/bias": (d,),
+        "qkv/kernel": (d, 3 * d), "qkv/bias": (3 * d,),
+        "proj/kernel": (d, d), "proj/bias": (d,),
+        "ln2/scale": (d,), "ln2/bias": (d,),
+        "fc/kernel": (d, ff), "fc/bias": (ff,),
+        "out/kernel": (ff, d), "out/bias": (d,),
+    }
+    for i in range(cfg.num_layers):
+        for leaf, shape in per_block.items():
+            out[f"block_{i}/{leaf}"] = shape
+    return out
+
+
+def _flatten(tree: Mapping) -> dict[str, Any]:
+    flat: dict[str, Any] = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(dict(tree))[0]:
+        flat["/".join(str(k.key) for k in kp)] = leaf
+    return flat
+
+
+def infer_config(params: Mapping, *, num_heads: int = 0, **overrides) -> GPT2Config:
+    """Reconstruct the serving :class:`GPT2Config` from a dense param
+    tree. Every geometry field except the head count is shape-derivable;
+    ``num_heads = 0`` falls back to the GPT-2 convention ``d_model/64``
+    (correct for the small/medium/large/xl family, WRONG for e.g.
+    ``GPT2Config.tiny`` — d_model 64, 4 heads — and undetectable from
+    shapes, so always pass ``--num-heads`` when serving a non-standard
+    checkpoint; a mismatch serves garbage silently). Extra kwargs
+    override config fields (e.g. ``dtype=jnp.float32`` for parity
+    testing)."""
+    vocab, d_model = params["wte"].shape
+    max_seq_len = params["wpe"].shape[0]
+    num_layers = sum(1 for k in params if str(k).startswith("block_"))
+    d_ff = params["block_0"]["fc"]["kernel"].shape[1]
+    kw = dict(
+        vocab_size=int(vocab),
+        max_seq_len=int(max_seq_len),
+        num_layers=int(num_layers),
+        num_heads=int(num_heads) or max(int(d_model) // 64, 1),
+        d_model=int(d_model),
+        d_ff=int(d_ff),
+        tie_head="head" not in params,
+    )
+    kw.update(overrides)
+    return GPT2Config(**kw)
+
+
+def validate_params(cfg: GPT2Config, params: Mapping) -> None:
+    """Raise with a precise diff when ``params`` deviates from the
+    :func:`expected_param_shapes` contract."""
+    expected = expected_param_shapes(cfg)
+    got = {k: tuple(v.shape) for k, v in _flatten(params).items()}
+    missing = sorted(set(expected) - set(got))
+    extra = sorted(set(got) - set(expected))
+    wrong = sorted(
+        f"{k}: {got[k]} != {expected[k]}"
+        for k in set(got) & set(expected)
+        if got[k] != expected[k]
+    )
+    if missing or extra or wrong:
+        raise ValueError(
+            "dense checkpoint does not match the serve param contract: "
+            f"missing={missing} extra={extra} shape-mismatch={wrong}"
+        )
+
+
+def load_gpt2_params(path: str, *, num_heads: int = 0, **overrides):
+    """Load a ``train.convert.save_dense`` ``.npz`` for serving.
+
+    Returns ``(params, cfg)``: the param tree as jnp arrays (moments and
+    step are dropped — serving is stateless) and the inferred, validated
+    :class:`GPT2Config`. This is the trained-checkpoint → engine path:
+    ``Engine(cfg, params)`` serves it directly.
+    """
+    from mpit_tpu.train.convert import load_dense
+
+    dense = load_dense(path)
+    params = jax.tree.map(jnp.asarray, dense.params)
+    cfg = infer_config(params, num_heads=num_heads, **overrides)
+    validate_params(cfg, params)
+    return params, cfg

@@ -129,6 +129,23 @@ def _realistic_results():
             "phases": phases,
             "obs_baseline": obs_baseline,
         },
+        "gpt2_serve": {
+            "decode_tokens_per_sec": 123456.7,
+            "serve_tokens_per_sec": 98765.4,
+            "latency_p50_s": 1.234567,
+            "latency_p95_s": 2.345678,
+            "ttft_p50_s": 0.123456,
+            "ttft_p95_s": 0.234567,
+            "slots": 8,
+            "requests": 24,
+            "generated_tokens": 1152,
+            "prompt_len": 64,
+            "max_new_tokens": 48,
+            "ticks": 144,
+            "occupancy_mean": 0.9583,
+            "phases": phases,
+            "obs_baseline": obs_baseline,
+        },
         "allreduce": {
             "gbps": 50.88,
             "modeled": True,
@@ -186,6 +203,17 @@ class TestLineBudget:
         # carries gbps + modeled only — the payload curve is detail-only.
         assert rec["detail"]["allreduce"]["modeled"] is True
         assert "by_payload_mb" not in rec["detail"]["allreduce"]
+        # The serving workload (ISSUE 4): decode tokens/s + request
+        # latency p50/p95 ride the line; TTFT percentiles, occupancy and
+        # stream geometry are detail-file-only.
+        serve = rec["detail"]["gpt2_serve"]
+        assert serve["decode_tokens_per_sec"] == 123456.7
+        assert serve["latency_p50_s"] == 1.234567
+        assert serve["latency_p95_s"] == 2.345678
+        for off_line in ("ttft_p50_s", "ttft_p95_s", "occupancy_mean",
+                        "generated_tokens", "serve_tokens_per_sec",
+                        "prompt_len", "ticks"):
+            assert off_line not in serve
         # The obs phase breakdown is detail-file-only too (ISSUE 1), and
         # so are the gap ATTRIBUTION (the line carries only the pct),
         # the perf-gate snapshot, and the MoE drop trajectory (ISSUE 3).
@@ -223,6 +251,7 @@ class TestLineBudget:
         # Worst case: every workload died before producing numbers.
         rec = json.loads(_line({}, truncated=[
             "allreduce", "alexnet", "gpt2", "resnet50", "gpt2_moe",
+            "gpt2_serve",
         ], elapsed_s=0.5))
         assert rec["value"] is None
         assert rec["vs_baseline"] is None

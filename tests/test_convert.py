@@ -382,6 +382,79 @@ class TestCrossTierRestore:
         assert jax.tree.leaves(ilv["stages"])[0].shape[:3] == (1, 2, 1)
 
 
+class TestServeLeafContract:
+    """ISSUE 4 satellite: the dense export → serve-loader round trip,
+    pinning the EXACT leaf names/shapes ``mpit_tpu.serve.weights``
+    consumes — a rename or reshape on either side (training export or
+    serving import) fails here, not silently at load time."""
+
+    def test_dense_export_matches_serve_contract(self, tmp_path):
+        from mpit_tpu.serve.weights import (
+            expected_param_shapes,
+            load_gpt2_params,
+        )
+        from mpit_tpu.train import load_dense, save_dense
+
+        params0 = _init_params()
+        dense = dense_from_dp(self._trained_state(params0))
+        path = str(tmp_path / "serve.npz")
+        save_dense(path, dense)
+
+        # The on-disk leaf paths are exactly the contract's paths.
+        loaded = load_dense(path)
+        expected = expected_param_shapes(CFG)
+        got = {
+            "/".join(str(k.key) for k in kp): tuple(leaf.shape)
+            for kp, leaf in jax.tree_util.tree_flatten_with_path(
+                loaded.params
+            )[0]
+        }
+        assert got == expected
+
+        # And the loader consumes it end to end: inferred config matches
+        # the training config's geometry, params validate.
+        params, cfg = load_gpt2_params(path, num_heads=CFG.num_heads)
+        for f in ("vocab_size", "max_seq_len", "num_layers", "num_heads",
+                  "d_model", "tie_head"):
+            assert getattr(cfg, f) == getattr(CFG, f), f
+        assert cfg.ff_dim == CFG.ff_dim
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            params,
+            loaded.params,
+        )
+
+    def test_loader_rejects_contract_drift(self, tmp_path):
+        from mpit_tpu.serve.weights import load_gpt2_params
+        from mpit_tpu.train.convert import DenseState, save_dense
+
+        params = jax.tree.map(np.asarray, _init_params())
+        params["block_0"]["qkv_fused"] = params["block_0"].pop("qkv")
+        path = str(tmp_path / "drifted.npz")
+        save_dense(
+            path, DenseState(step=0, params=params, moments=[], scalars=[])
+        )
+        with pytest.raises(ValueError, match="contract"):
+            load_gpt2_params(path, num_heads=CFG.num_heads)
+
+    @staticmethod
+    def _trained_state(params0):
+        """A couple of real DP steps so the export is a TRAINED state,
+        not an init artifact (moments present and dropped by the serve
+        loader)."""
+        from mpit_tpu.train.step import make_train_step
+
+        world = mpit_tpu.init()
+        tx = goo(LR, MOM)
+        init_fn, step_fn, _ = make_train_step(_dp_loss_fn(), tx, world)
+        state = init_fn(params0)
+        for toks in _batches(2):
+            state, _ = step_fn(state, shard_batch(world, {"tokens": toks}))
+        return state
+
+
 class TestElasticRescale:
     """Round-3 verdict item 7: preempt on 8 devices, restore the dense
     checkpoint onto a 4-device mesh (data axis halved, ZeRO-1 shards

@@ -848,6 +848,44 @@ class TestSentinel:
             0.02, rel=0.15
         )
 
+    def test_configured_phases_catch_decode_spike(self):
+        """ISSUE 4 satellite: the detector runs on SERVE tick streams —
+        a sentinel configured for decode/prefill flags an injected
+        decode spike and ignores every non-configured metric."""
+        s = obs.Sentinel(phases=("decode", "prefill"), warmup=4)
+        stream = self._clean_stream(60, base=0.01, jitter=0.0004)
+        stream[40] = 0.2  # 20x decode stall (a slot-batch hiccup)
+        for i, v in enumerate(stream):
+            s.observe_phases(i, decode=v, step=5.0)  # step: huge, ignored
+        rep = s.report()
+        assert rep["anomaly_counts"] == {"spike": 1}
+        (a,) = rep["anomalies"]
+        assert a["kind"] == "spike" and a["metric"] == "decode"
+        assert a["step"] == 40
+        # The non-configured metric never grew a detector.
+        assert set(rep["metrics"]) == {"decode"}
+
+    def test_phases_filter_applies_to_observe_step_too(self):
+        """A decode-only sentinel handed to hardened_loop stays silent:
+        observe/observe_step drop non-configured metrics, including the
+        prefetch-starvation verdict."""
+        s = obs.Sentinel(phases=("decode",), warmup=2, sustained_n=2)
+        for i in range(40):
+            # Massive step spikes + total starvation — all off-phase.
+            s.observe_step(
+                i, step_s=10.0 * (i % 7), prefetch_wait_s=100.0,
+                iteration_s=100.1,
+            )
+        rep = s.report()
+        assert rep["clean"], rep["anomaly_counts"]
+        assert rep["metrics"] == {}
+
+    def test_observe_phases_skips_none_values(self):
+        s = obs.Sentinel(warmup=2)
+        for i in range(10):
+            s.observe_phases(i, decode=0.01, prefill=None)
+        assert set(s.report()["metrics"]) == {"decode"}
+
     def test_anomaly_cap_reports_overflow(self):
         s = obs.Sentinel(max_anomalies=3, warmup=2, window=8)
         for i in range(8):

@@ -1,0 +1,361 @@
+"""ISSUE 4 acceptance: the continuous-batching KV-cache inference engine.
+
+The done-criteria (ISSUE 4):
+
+- greedy decode through the KV-cache engine bit-matches the no-cache
+  ``models.gpt2`` forward for EVERY request in a staggered
+  continuous-batching run (admits and retires interleaved, slots
+  reused);
+- the obs summary carries per-request TTFT / end-to-end latency
+  histograms (p50/p95) and the prefill/decode phase spans;
+- the CLI serves a synthetic stream end to end.
+
+All parity tests run the f32 tiny config: the point is exact token
+equality between the cached and uncached paths, not dtype tolerance.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpit_tpu
+from mpit_tpu import obs
+from mpit_tpu.models import GPT2, GPT2Config
+from mpit_tpu.serve import Engine, Request, Server
+
+CFG = GPT2Config.tiny(
+    vocab_size=64, max_seq_len=64, num_layers=2, num_heads=2, d_model=32,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = GPT2(CFG)
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def ref_greedy(model, params, prompt: list[int], n: int) -> list[int]:
+    """The no-cache oracle: full forward per token, argmax append."""
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        logits = model.apply(
+            {"params": params}, jnp.asarray([toks], jnp.int32)
+        )
+        t = int(jnp.argmax(logits[0, -1]))
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+PROMPTS = [[5, 9, 3], [7], [1, 2, 3, 4, 5], [9, 9], [3, 1], [60, 2, 2, 1]]
+MAX_NEW = [6, 4, 8, 3, 5, 7]
+
+
+class TestKVCacheParity:
+    def test_prefill_logits_match_full_forward(self, model_and_params):
+        """The cache-aware forward at lengths=0 IS the plain forward:
+        same logits at every real prompt position (padded batch)."""
+        model, params = model_and_params
+        from mpit_tpu.serve import alloc_cache
+
+        prompt = [5, 9, 3, 1]
+        cache = alloc_cache(CFG, slots=2, max_len=16)
+        padded = np.zeros((2, 8), np.int32)
+        padded[0, : len(prompt)] = prompt
+        logits, (k2, v2) = model.apply(
+            {"params": params},
+            jnp.asarray(padded),
+            cache=(cache.k, cache.v, cache.lengths),
+        )
+        full = model.apply(
+            {"params": params}, jnp.asarray([prompt], jnp.int32)
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits[0, : len(prompt)]),
+            np.asarray(full[0]),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+        assert k2.shape == cache.k.shape and v2.shape == cache.v.shape
+
+    def test_single_request_greedy_bitmatch(self, model_and_params):
+        model, params = model_and_params
+        engine = Engine(CFG, params, slots=2, max_len=32, prefill_len=8)
+        server = Server(engine)
+        server.submit(Request(rid=0, prompt=[5, 9, 3], max_new_tokens=6))
+        (done,) = server.run()
+        assert done.tokens == ref_greedy(model, params, [5, 9, 3], 6)
+
+    def test_staggered_continuous_batching_bitmatch(self, model_and_params):
+        """THE acceptance run: 6 requests of heterogeneous prompt/output
+        lengths through 2 slots — admits ride later prefills as slots
+        retire, and every request's greedy output equals its isolated
+        no-cache run."""
+        model, params = model_and_params
+        engine = Engine(CFG, params, slots=2, max_len=40, prefill_len=8)
+        server = Server(engine)
+        for i, (p, n) in enumerate(zip(PROMPTS, MAX_NEW)):
+            server.submit(Request(rid=i, prompt=p, max_new_tokens=n))
+        done = server.run()
+        assert len(done) == len(PROMPTS)
+        # Slot reuse actually happened: more admissions than slots, and
+        # the queue drained through retirements (continuous batching).
+        assert server.admissions == len(PROMPTS) > engine.slots
+        for c in done:
+            assert c.tokens == ref_greedy(
+                model, params, c.prompt, len(c.tokens)
+            ), f"request {c.rid} diverged from its isolated run"
+
+    def test_slot_state_isolated_across_reuse(self, model_and_params):
+        """A slot's previous occupant must not leak: run the same
+        request before and after an unrelated long request churned
+        through every slot."""
+        model, params = model_and_params
+        engine = Engine(CFG, params, slots=1, max_len=40, prefill_len=8)
+        probe = Request(rid="a", prompt=[9, 9], max_new_tokens=4)
+        server = Server(engine)
+        server.submit(probe)
+        server.submit(Request(rid="mid", prompt=[1, 2, 3], max_new_tokens=8))
+        server.submit(Request(rid="b", prompt=[9, 9], max_new_tokens=4))
+        done = {c.rid: c.tokens for c in server.run()}
+        assert done["a"] == done["b"]
+
+
+class TestEngineMechanics:
+    def test_eos_retirement(self, model_and_params):
+        model, params = model_and_params
+        full = ref_greedy(model, params, [5, 9, 3], 6)
+        eos = full[2]  # stop at the 3rd generated token
+        engine = Engine(CFG, params, slots=2, max_len=32, prefill_len=8)
+        server = Server(engine)
+        server.submit(
+            Request(rid=0, prompt=[5, 9, 3], max_new_tokens=6, eos_id=eos)
+        )
+        (done,) = server.run()
+        assert done.tokens == full[:3]  # EOS included, then retired
+
+    def test_cache_full_retires_truncated(self, model_and_params):
+        """The cache-overrun guard is defense in depth: submit()
+        validation makes it unreachable, so inject past it — a request
+        whose budget exceeds the buffer must retire at the last
+        writable position, flagged truncated, not overrun."""
+        _, params = model_and_params
+        from mpit_tpu.serve.scheduler import _Live
+
+        engine = Engine(CFG, params, slots=1, max_len=8, prefill_len=6)
+        server = Server(engine)
+        import time
+
+        server.queue.append(
+            _Live(
+                Request(rid=0, prompt=[1, 2, 3, 4], max_new_tokens=10),
+                time.perf_counter(),
+            )
+        )
+        (done,) = server.run()
+        # prefill caches 4; each decode tick writes one more; the slot
+        # retires when the NEXT write would hit max_len=8 -> 4 + 5 - 1
+        # = 8 cached positions attempted, 5 tokens emitted.
+        assert len(done.tokens) == 5
+        assert done.truncated
+
+    def test_submit_validation(self, model_and_params):
+        _, params = model_and_params
+        engine = Engine(CFG, params, slots=1, max_len=16, prefill_len=4)
+        server = Server(engine)
+        with pytest.raises(ValueError, match="prompt length"):
+            server.submit(Request(rid=0, prompt=[1] * 5))
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            server.submit(
+                Request(rid=1, prompt=[1, 2], max_new_tokens=15)
+            )
+        with pytest.raises(ValueError, match="empty"):
+            server.submit(Request(rid=2, prompt=[]))
+        with pytest.raises(ValueError, match="max_new_tokens must be"):
+            server.submit(Request(rid=3, prompt=[1], max_new_tokens=0))
+
+    def test_cache_and_targets_are_mutually_exclusive(
+        self, model_and_params
+    ):
+        model, params = model_and_params
+        from mpit_tpu.serve import alloc_cache
+
+        cache = alloc_cache(CFG, slots=1, max_len=8)
+        toks = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            model.apply(
+                {"params": params},
+                toks,
+                targets=toks,
+                cache=(cache.k, cache.v, cache.lengths),
+            )
+
+    def test_sampling_modes_run_and_are_seeded(self, model_and_params):
+        """Temperature/top-k sampling: valid tokens, reproducible under
+        the engine seed, and top_k=1 degenerates to greedy."""
+        model, params = model_and_params
+
+        def run(seed, temperature, top_k):
+            engine = Engine(
+                CFG, params, slots=2, max_len=32, prefill_len=8, seed=seed
+            )
+            server = Server(engine)
+            for i in range(3):
+                server.submit(
+                    Request(
+                        rid=i,
+                        prompt=PROMPTS[i],
+                        max_new_tokens=5,
+                        temperature=temperature,
+                        top_k=top_k,
+                    )
+                )
+            return {c.rid: c.tokens for c in server.run()}
+
+        a = run(0, 1.0, 0)
+        assert all(
+            0 <= t < CFG.vocab_size for toks in a.values() for t in toks
+        )
+        assert a == run(0, 1.0, 0), "same seed must reproduce"
+        # top_k=1 keeps only the argmax token: greedy by construction.
+        b = run(3, 5.0, 1)
+        for rid, toks in b.items():
+            assert toks == ref_greedy(
+                model, params, PROMPTS[rid], len(toks)
+            )
+
+
+class TestServeObservability:
+    def test_summary_carries_request_histograms(self, model_and_params):
+        _, params = model_and_params
+        rec = obs.Recorder()
+        with obs.local_recorder(rec):
+            engine = Engine(CFG, params, slots=2, max_len=40, prefill_len=8)
+            server = Server(engine)
+            for i, (p, n) in enumerate(zip(PROMPTS, MAX_NEW)):
+                server.submit(Request(rid=i, prompt=p, max_new_tokens=n))
+            server.run()
+            summ = rec.summary()
+        phases = summ["phases"]
+        for name in ("request_ttft", "request_latency", "queue_wait"):
+            assert phases[name]["count"] == len(PROMPTS)
+            assert phases[name]["p50_s"] <= phases[name]["p95_s"]
+        # One prefill span per admission BATCH (continuous batching
+        # coalesces same-tick admits), one decode span per tick.
+        assert 1 <= phases["prefill"]["count"] <= server.admissions
+        assert phases["decode"]["count"] >= max(MAX_NEW) - 1
+        # TTFT <= end-to-end latency, per construction of the intervals.
+        assert (
+            phases["request_ttft"]["p50_s"]
+            <= phases["request_latency"]["p50_s"]
+        )
+        assert summ["counters"]["serve_requests"] == len(PROMPTS)
+        assert ("slot_occupancy", ()) in rec.gauges
+        # The per-request intervals land in the exported trace too.
+        events = obs.snapshot_trace_events(rec.snapshot())
+        assert any(e["name"] == "request_latency" for e in events)
+
+    def test_server_stats_shape(self, model_and_params):
+        _, params = model_and_params
+        engine = Engine(CFG, params, slots=2, max_len=40, prefill_len=8)
+        server = Server(engine)
+        for i in range(3):
+            server.submit(Request(rid=i, prompt=[1 + i], max_new_tokens=3))
+        server.run()
+        stats = server.stats()
+        assert stats["requests_completed"] == 3
+        assert stats["generated_tokens"] == 9
+        assert 0 < stats["occupancy_mean"] <= 1.0
+        for k in ("latency_p50_s", "latency_p95_s", "ttft_p50_s",
+                  "ttft_p95_s"):
+            assert stats[k] > 0
+
+
+class TestTensorParallelEngine:
+    def test_tp_engine_matches_dense_greedy(self, model_and_params):
+        """The megatron-rules TP engine (column qkv/fc, row proj/out,
+        head-sharded cache) produces the same greedy tokens as the
+        isolated no-cache runs on a data=4,model=2 mesh."""
+        model, params = model_and_params
+        world = mpit_tpu.init({"data": 4, "model": 2}, set_default=False)
+        engine = Engine(
+            CFG, params, slots=2, max_len=40, prefill_len=8,
+            world=world, tp_axis="model",
+        )
+        server = Server(engine)
+        for i, (p, n) in enumerate(zip(PROMPTS[:4], MAX_NEW[:4])):
+            server.submit(Request(rid=i, prompt=p, max_new_tokens=n))
+        done = server.run()
+        assert len(done) == 4
+        for c in done:
+            assert c.tokens == ref_greedy(
+                model, params, c.prompt, len(c.tokens)
+            ), f"TP request {c.rid} diverged"
+
+    def test_tp_cache_is_head_sharded(self, model_and_params):
+        _, params = model_and_params
+        world = mpit_tpu.init({"data": 4, "model": 2}, set_default=False)
+        engine = Engine(
+            CFG, params, slots=2, max_len=16, prefill_len=8,
+            world=world, tp_axis="model",
+        )
+        # [L, S, T, H, Dh] with H split over the 2-way model axis.
+        shard_shapes = {
+            s.data.shape for s in engine.cache.k.addressable_shards
+        }
+        assert shard_shapes == {
+            (CFG.num_layers, 2, 16, CFG.num_heads // 2, CFG.head_dim)
+        }
+
+
+class TestServeCLI:
+    def test_cli_smoke_random_init(self):
+        from mpit_tpu.serve.__main__ import main
+
+        out = main(
+            [
+                "--requests", "4", "--slots", "2", "--max-len", "48",
+                "--prefill-len", "8", "--max-new-tokens", "4",
+                "--sentinel", "true",
+            ]
+        )
+        assert out["requests_completed"] == 4
+        assert out["generated_tokens"] == 16
+        assert out["decode_tokens_per_sec"] > 0
+        assert out["obs_summary"]["request_latency"]["count"] == 4
+        assert out["sentinel"]["clean"] in (True, False)
+
+    def test_cli_serves_dense_checkpoint(self, tmp_path, model_and_params):
+        """The trained-checkpoint → serve path: save_dense → --ckpt."""
+        from mpit_tpu.serve.__main__ import main
+        from mpit_tpu.train.convert import DenseState, save_dense
+
+        _, params = model_and_params
+        path = str(tmp_path / "state.npz")
+        save_dense(
+            path,
+            DenseState(
+                step=0,
+                params=jax.tree.map(np.asarray, params),
+                moments=[],
+                scalars=[],
+            ),
+        )
+        out = main(
+            [
+                "--ckpt", path, "--num-heads", str(CFG.num_heads),
+                "--requests", "3", "--slots", "2", "--max-len", "32",
+                "--prefill-len", "8", "--max-new-tokens", "3",
+            ]
+        )
+        assert out["requests_completed"] == 3
+        assert out["model"]["layers"] == CFG.num_layers
+        assert out["model"]["vocab"] == CFG.vocab_size
