@@ -685,49 +685,32 @@ def bench_moe(calls: int = 4, warmup: int = 1, seq: int = 512, batch_per_device:
     }
 
 
-def bench_gpt2_serve(
-    slots: int = 8,
-    prompt_len: int = 64,
-    max_new: int = 48,
-    requests: int = 24,
-    max_len: int = 128,
+def _serve_stream(
+    cfg, params, *, slots, max_len, prompt_len, max_new, requests,
+    decode_attention, seed=0,
 ):
-    """GPT-2 serving throughput/latency on the continuous-batching
-    engine (ISSUE 4): decode tokens/sec over the KV-cache decode path
-    plus per-request latency percentiles, measured on a synthetic
-    request stream saturating ``slots`` concurrent cache slots.
-
-    Warmup runs ONE request through the engine first so the two compiles
-    (prefill + decode — the engine's whole compiled surface) never land
-    inside a measured request's TTFT/latency; the engine then resets
-    (cache cleared, compiled steps kept) and the stream is measured
-    cold-queue: all requests submitted up front, so queue-wait and
-    slot-reuse are exercised (admissions > slots).
-    """
-    import mpit_tpu
-    from mpit_tpu import obs
-    from mpit_tpu.models import GPT2, GPT2Config
-    from mpit_tpu.serve import Engine, Request, Server
-
-    world = mpit_tpu.init()
-    del world  # serving is single-replica here; TP variant is test-covered
+    """One measured request stream through a fresh engine: warmup runs
+    ONE request first so the two compiles (prefill + decode — the
+    engine's whole compiled surface) never land inside a measured
+    request's TTFT/latency; then the engine resets (cache cleared,
+    compiled steps kept) and the stream is measured cold-queue: all
+    requests submitted up front, so queue-wait and slot-reuse are
+    exercised (admissions > slots)."""
     import numpy as np
 
-    cfg = GPT2Config.small(max_seq_len=max_len, head_dtype=jnp.bfloat16)
-    params = jax.jit(GPT2(cfg).init)(
-        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
-    )["params"]
-    engine = Engine(
-        cfg, params, slots=slots, max_len=max_len, prefill_len=prompt_len
-    )
+    from mpit_tpu import obs
+    from mpit_tpu.serve import Engine, Request, Server
 
-    rng = np.random.RandomState(0)
+    engine = Engine(
+        cfg, params, slots=slots, max_len=max_len, prefill_len=prompt_len,
+        decode_attention=decode_attention,
+    )
+    rng = np.random.RandomState(seed)
     make_req = lambda i: Request(
         rid=i,
         prompt=rng.randint(0, cfg.vocab_size, size=prompt_len).tolist(),
         max_new_tokens=max_new,
     )
-
     with obs.span("warmup", calls=1):
         warm = Server(engine)
         warm.submit(
@@ -753,10 +736,69 @@ def bench_gpt2_serve(
     if rec is not None:
         phases = rec.summary(since=n0)["phases"]
         decode_s = phases.get("decode", {}).get("total_s", wall)
-    return {
+    return engine, stats, wall, decode_tokens, decode_s, gen
+
+
+def bench_gpt2_serve(
+    slots: int = 8,
+    prompt_len: int = 64,
+    max_new: int = 48,
+    requests: int = 24,
+    max_len: int = 128,
+    decode_attention: str = "kernel",
+    sweep_lengths: tuple = (64, 256, 1024),
+):
+    """GPT-2 serving throughput/latency on the continuous-batching
+    engine: decode tokens/sec over the KV-cache decode path plus
+    per-request latency percentiles, on a synthetic request stream
+    saturating ``slots`` concurrent cache slots.
+
+    ISSUE 5 grows two comparisons around the headline stream:
+
+    - the same stream re-measured with ``decode_attention="reference"``
+      (the dense PR 4 hot loop) — ``reference_decode_tokens_per_sec``,
+      detail-only, the kernel-on/off A-B at identical geometry;
+    - a decode-throughput-vs-context-length sweep (detail-only,
+      ``decode_sweep``): short generations at prompt lengths
+      ``sweep_lengths`` on a reduced-depth config (geometry recorded in
+      the entry) — with the length-aware kernel the curve should
+      flatten relative to O(max_len) dense decode; ``kv_blocks_*``
+      record how many cache tiles a tick actually visits.
+
+    The record line carries the resolved ``decode_attention`` mode
+    (what actually executed — "kernel" falls back to "reference" math
+    off-TPU, and the line must say so).
+    """
+    import numpy as np
+
+    import mpit_tpu
+    from mpit_tpu import obs
+    from mpit_tpu.models import GPT2, GPT2Config
+    from mpit_tpu.ops.decode_attention import num_kv_blocks
+    from mpit_tpu.serve import Engine, Request, Server
+
+    world = mpit_tpu.init()
+    del world  # serving is single-replica here; TP variant is test-covered
+
+    cfg = GPT2Config.small(max_seq_len=max_len, head_dtype=jnp.bfloat16)
+    params = jax.jit(GPT2(cfg).init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine, stats, wall, decode_tokens, decode_s, gen = _serve_stream(
+        cfg, params, slots=slots, max_len=max_len, prompt_len=prompt_len,
+        max_new=max_new, requests=requests,
+        decode_attention=decode_attention,
+    )
+    out = {
         "decode_tokens_per_sec": (
             round(decode_tokens / decode_s, 1) if decode_s else None
         ),
+        "decode_attention": engine.decode_attention_mode,
+        # Off-TPU "kernel" mode falls back to reference ATTENTION but
+        # keeps the blocked sampler (pure XLA) — this detail key is what
+        # distinguishes that engine from a true decode_attention=
+        # "reference" run, which is dense end to end.
+        "decode_sampler": engine.decode_sampler,
         "serve_tokens_per_sec": round(gen / wall, 1),
         "latency_p50_s": stats.get("latency_p50_s"),
         "latency_p95_s": stats.get("latency_p95_s"),
@@ -770,6 +812,101 @@ def bench_gpt2_serve(
         "ticks": stats["ticks"],
         "occupancy_mean": stats["occupancy_mean"],
     }
+    # Kernel-on/off A-B at identical geometry (detail-only). Guard on the
+    # RESOLVED mode: off-TPU a requested "kernel" already ran reference
+    # ATTENTION, so a second stream could only A-B the blocked-vs-dense
+    # sampler — not the kernel claim this number exists to pin — at
+    # double the runtime; skip it and let decode_sampler (above) record
+    # which head the measured stream actually ran.
+    if engine.decode_attention_mode != "reference":
+        _, rstats, rwall, rtok, rdecode_s, rgen = _serve_stream(
+            cfg, params, slots=slots, max_len=max_len,
+            prompt_len=prompt_len, max_new=max_new, requests=requests,
+            decode_attention="reference",
+        )
+        out["reference_decode_tokens_per_sec"] = (
+            round(rtok / rdecode_s, 1) if rdecode_s else None
+        )
+    # Context-length sweep (detail-only): decode cost vs cached context
+    # inside ONE long-cache engine — THE tentpole claim ("scale with
+    # context, not cache size") in curve form. Every point shares the
+    # same engine/cache geometry (max_len fits the longest context), so
+    # the dense reference pays the full buffer at every length while
+    # the length-aware kernel pays ceil((L+1)/block_k) tiles. Reduced
+    # depth keeps the sweep affordable; the CURVE, not the absolute
+    # rate, is the signal — geometry is recorded alongside.
+    sweep_cfg = GPT2Config.small(
+        num_layers=2,
+        max_seq_len=max(sweep_lengths) + 32,
+        head_dtype=jnp.bfloat16,
+    )
+    sweep_params = jax.jit(GPT2(sweep_cfg).init)(
+        jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    s_len = max(sweep_lengths) + 16
+    s_slots, s_new = 4, 8
+    sweep_engine = Engine(
+        sweep_cfg, sweep_params, slots=s_slots, max_len=s_len,
+        prefill_len=max(sweep_lengths),
+        decode_attention=decode_attention,
+    )
+    rng = np.random.RandomState(1)
+    bk = sweep_engine.decode_block_k
+    rec = obs.get_recorder()
+
+    def _sweep_point(ctx, warm=False):
+        sweep_engine.reset()
+        server = Server(sweep_engine)
+        for i in range(s_slots):
+            server.submit(
+                Request(
+                    rid=i,
+                    prompt=rng.randint(
+                        0, sweep_cfg.vocab_size, size=ctx
+                    ).tolist(),
+                    max_new_tokens=2 if warm else s_new,
+                )
+            )
+        n0 = rec.event_count() if rec else 0
+        t0 = time.perf_counter()
+        server.run()
+        wall = time.perf_counter() - t0
+        stats = server.stats()
+        dtok = stats["generated_tokens"] - stats["requests_completed"]
+        ds = wall
+        if rec is not None:
+            ph = rec.summary(since=n0)["phases"]
+            ds = ph.get("decode", {}).get("total_s", wall)
+        return dtok, ds
+
+    with obs.span("warmup", calls=1):
+        _sweep_point(min(sweep_lengths), warm=True)  # the two compiles
+    sweep = []
+    for ctx in sweep_lengths:
+        dtok, ds = _sweep_point(ctx)
+        sweep.append(
+            {
+                "context_len": ctx,
+                "decode_tokens_per_sec": round(dtok / ds, 1) if ds else None,
+                "kv_blocks_visited_per_slot": int(
+                    num_kv_blocks(np.asarray([ctx]), 1, s_len, bk)[0]
+                ),
+                "kv_blocks_total": s_len // bk,
+            }
+        )
+    out["decode_sweep"] = {
+        "config": {
+            "num_layers": sweep_cfg.num_layers,
+            "d_model": sweep_cfg.d_model,
+            "slots": s_slots,
+            "max_new": s_new,
+            "max_len": s_len,
+            "block_k": bk,
+            "decode_attention": sweep_engine.decode_attention_mode,
+        },
+        "points": sweep,
+    }
+    return out
 
 
 def bench_allreduce(payload_mb: int = 64, iters: int = 10):
@@ -900,8 +1037,8 @@ _LINE_KEYS = {
         "final_loss", "error",
     ),
     "gpt2_serve": (
-        "decode_tokens_per_sec", "latency_p50_s", "latency_p95_s",
-        "slots", "requests", "error",
+        "decode_tokens_per_sec", "decode_attention", "latency_p50_s",
+        "latency_p95_s", "slots", "requests", "error",
     ),
     "allreduce": ("gbps", "modeled", "devices", "error"),
 }

@@ -115,6 +115,12 @@ class GPT2Config:
     # stage 0 while a tied head's would live on every stage, and the two
     # contributions cannot be combined per-leaf after AD.
     tie_head: bool = True
+    # Attention used on the CACHE path (serving). None = the dense
+    # reference :func:`cached_attention`; the serving engine plugs in
+    # :func:`mpit_tpu.ops.flash_decode_attention` here (ISSUE 5) —
+    # same ``(q, k_cache, v_cache, lengths)`` signature. The training
+    # path (``attention_fn``) is untouched by this field.
+    cache_attention_fn: Any = None
 
     @property
     def ln_out_dtype(self):
@@ -168,7 +174,8 @@ class Block(nn.Module):
             k_cache, v_cache, lengths = layer_cache
             k_cache = cache_update(k_cache, split(k), lengths)
             v_cache = cache_update(v_cache, split(v), lengths)
-            attn = cached_attention(split(q), k_cache, v_cache, lengths)
+            attn_fn = cfg.cache_attention_fn or cached_attention
+            attn = attn_fn(split(q), k_cache, v_cache, lengths)
             new_cache = (k_cache, v_cache)
         attn = attn.reshape(*attn.shape[:-2], cfg.d_model)
         x = x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="proj")(attn)
@@ -184,7 +191,10 @@ class GPT2(nn.Module):
     cfg: GPT2Config = GPT2Config()
 
     @nn.compact
-    def __call__(self, tokens, positions=None, targets=None, cache=None):
+    def __call__(
+        self, tokens, positions=None, targets=None, cache=None,
+        return_hidden=False,
+    ):
         """tokens [B, T] int32 → logits [B, T, vocab] float32.
 
         ``positions`` ([T] or [B, T] int32) overrides the default
@@ -207,8 +217,20 @@ class GPT2(nn.Module):
         (new_k, new_v))``. Prefill = call with ``lengths = 0`` and the
         padded prompt; decode = call with T = 1. Mutually exclusive with
         ``targets``.
+
+        ``return_hidden`` (serving; requires ``cache``): skip the LM-head
+        matmul and return the final post-``ln_f`` hidden states
+        ``[B, T, d_model]`` in place of logits — the blocked decode head
+        (:func:`mpit_tpu.ops.lm_head.lm_head_sample`) samples straight
+        from these, so the ``[B, T, vocab]`` f32 logits array never
+        exists in the decode step.
         """
         cfg = self.cfg
+        if return_hidden and cache is None:
+            raise ValueError(
+                "return_hidden is the serving decode-head path; it "
+                "requires cache="
+            )
         if cache is not None:
             if targets is not None:
                 raise ValueError(
@@ -249,6 +271,8 @@ class GPT2(nn.Module):
                 new_k.append(k_i)
                 new_v.append(v_i)
         x = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln_f")(x)
+        if return_hidden:
+            return x, (jnp.stack(new_k), jnp.stack(new_v))
         # LM head (f32 accumulation regardless of operand dtype); tied to
         # wte by default, separate under tie_head=False (see GPT2Config).
         head = (

@@ -198,10 +198,26 @@ class Recorder:
         """
         snap = self.snapshot()
         by_name: dict[str, list[float]] = {}
-        for kind, name, _t0, dur, _tid, _attrs in snap["events"][since:]:
+        labels: dict[str, dict[str, set]] = {}
+        for kind, name, _t0, dur, _tid, attrs in snap["events"][since:]:
             if kind == "X":
                 by_name.setdefault(name, []).append(dur)
+                # String-valued span attrs are mode LABELS (e.g. the
+                # serve path's attention="kernel"|"reference") — roll
+                # the distinct values up so a report reader can see
+                # which implementation a phase actually ran (ISSUE 5:
+                # attributing a serve regression to kernel fallback).
+                if attrs:
+                    lab = labels.setdefault(name, {})
+                    for k, v in attrs.items():
+                        if isinstance(v, str):
+                            lab.setdefault(k, set()).add(v)
         phases = phase_stats(by_name)
+        for name, lab in labels.items():
+            if lab and name in phases:
+                phases[name]["labels"] = {
+                    k: sorted(vs) for k, vs in lab.items()
+                }
         colls = [
             ({**dict(k[1])}, v)
             for k, v in snap["counters"].items()

@@ -13,27 +13,43 @@ hand-scheduled TPU kernels below the XLA tier:
   block under ring attention.
 - :mod:`mpit_tpu.ops.lm_head` — fused LM-head cross entropy (the same
   online-logsumexp trick applied over the vocabulary axis; never
-  materializes the [B, T, vocab] f32 logits).
+  materializes the [B, T, vocab] f32 logits), plus the blocked decode
+  head ``lm_head_sample`` (greedy/top-k/temperature sampling with a
+  running top-k merge across vocab blocks — the serving analogue).
+- :mod:`mpit_tpu.ops.decode_attention` — flash-decode against the padded
+  per-slot KV cache: blocked over the cache length with online softmax
+  and per-slot length-aware block skipping (K/V stay in HBM; a slot
+  holding L tokens pays ceil((L+T)/block_k) tiles, not max_len/block_k)
+  — the serving hot-loop kernel (ISSUE 5).
 
 Every kernel has an ``interpret`` path so its semantics are testable on
 the CPU fake mesh (SURVEY.md §6 "race detection" row), and an XLA
 fallback for non-TPU backends.
 """
 
+from mpit_tpu.ops.decode_attention import (
+    flash_decode_attention,
+    num_kv_blocks,
+    reference_decode_attention,
+)
 from mpit_tpu.ops.flash_attention import (
     flash_attention,
     flash_attention_block,
     merge_attention,
     reference_attention,
 )
-from mpit_tpu.ops.lm_head import lm_head_xent
+from mpit_tpu.ops.lm_head import lm_head_sample, lm_head_xent
 from mpit_tpu.ops.ring_allreduce import ring_allreduce
 
 __all__ = [
     "flash_attention",
     "flash_attention_block",
+    "flash_decode_attention",
     "merge_attention",
+    "num_kv_blocks",
     "reference_attention",
+    "reference_decode_attention",
+    "lm_head_sample",
     "lm_head_xent",
     "ring_allreduce",
 ]

@@ -1,0 +1,327 @@
+"""Pallas flash-decode — length-aware attention against the padded KV cache.
+
+The serving hot path (ISSUE 5 tentpole). PR 4's engine decodes with
+:func:`mpit_tpu.models.gpt2.cached_attention`: a dense XLA attention that
+scores every query against the **entire padded cache buffer**
+``[slots, max_len]`` and materializes the f32 ``[B, H, T, S]`` score
+tensor — so a decode tick costs O(max_len) HBM traffic and FLOPs even
+when the slots hold 30-token contexts. This kernel makes the tick cost
+scale with the *context actually cached*:
+
+- **Blocked over the cache length with online softmax.** The kernel
+  streams ``block_k``-sized K/V tiles through a ``fori_loop``, carrying
+  the flash running max/denominator/accumulator in f32 (the same
+  structure as :mod:`mpit_tpu.ops.flash_attention`); the ``[T, S]``
+  score matrix never exists — only a ``[T, block_k]`` f32 tile.
+- **Per-slot length-aware block skipping.** The k-loop bound is derived
+  from the slot's ``lengths`` entry (an SMEM scalar): a slot holding
+  ``L`` tokens visits ``ceil((L+T)/block_k)`` tiles, not
+  ``max_len/block_k``. Because K/V stay in **HBM** (``memory_space=ANY``)
+  and the kernel DMAs tiles in itself (double-buffered, overlap with
+  compute), skipped tiles cost neither FLOPs *nor* HBM reads — the
+  BlockSpec-prefetch form would have copied the whole padded row.
+- **Heads-local.** One grid program per slot computes every head it was
+  given (python-unrolled over the packed ``[rows, H·D]`` lane layout of
+  the training kernel), so the TP engine calls it unchanged on its
+  H/P head shard.
+- **Small-T prefill tail.** ``T`` is static per trace; the engine's
+  padded prefill (``T = prefill_len``, ``lengths = 0``) and its decode
+  tick (``T = 1``) are two traces of the same kernel.
+
+Parity contract: visibility is ``key j visible to query t iff
+j <= lengths + t`` — exactly :func:`~mpit_tpu.models.gpt2.cached_attention`
+(the reference), whose masked rows contribute exact zeros. Masked
+positions inside a visited boundary tile score ``-1e30``; ``exp``
+underflows to exactly 0.0 in f32, and tiles past the loop bound are
+never read — so the kernel's masked-key contribution is exactly zero
+too, and greedy decode through it preserves the PR 4 bit-match
+invariant at the token level.
+
+On non-TPU backends (``interpret=None``) the same math runs as the
+reference XLA path; ``interpret=True`` forces the kernel through the
+Pallas interpreter (the CPU-mesh test path, like the training kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    "flash_decode_attention",
+    "reference_decode_attention",
+    "num_kv_blocks",
+    "pick_block_k",
+]
+
+_NEG_INF = -1e30  # large-but-finite; exp underflows to exactly 0.0 in f32
+
+
+def _use_kernel(interpret: bool | None) -> bool:
+    if interpret is not None:
+        return True
+    return jax.devices()[0].platform == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Reference (XLA) path — also the non-TPU fallback.
+# ---------------------------------------------------------------------------
+
+
+def reference_decode_attention(q, k, v, lengths):
+    """Dense cached attention, [B, T, H, Dh] vs padded [B, S, H, Dh].
+
+    Delegates to :func:`mpit_tpu.models.gpt2.cached_attention` — the
+    kernel's oracle and the non-TPU fallback ARE the serving reference,
+    one implementation, so a numerics change there cannot silently
+    desynchronize this module (the bitwise pin in
+    ``tests/test_decode_attention.py`` now guards only the signature).
+    Imported lazily: ops sits below models in the layering, and the
+    models package must not load just because ops does.
+    """
+    from mpit_tpu.models.gpt2 import cached_attention
+
+    return cached_attention(q, k, v, lengths)
+
+
+def pick_block_k(s: int, want: int | None = None) -> int:
+    """Resolve the cache-length tile: an explicit ``want`` is clamped to
+    S; ``None`` auto-picks the largest power of two ≤ 256 dividing S
+    (descending, floor 8 — the f32 sublane tile), falling back to S
+    itself (one tile, no skipping) when nothing divides. 256 (not the
+    training kernel's 512) because decode queries are 1–few rows: the
+    per-tile matmul is VPU-bound either way, and a finer tile skips
+    more of a short context."""
+    if want is not None:
+        return min(want, s)
+    b = 256
+    while b > 8 and (s % b or s // b < 4):
+        b //= 2
+    return b if s % b == 0 else s
+
+
+def num_kv_blocks(lengths, t_q: int, s: int, block_k: int):
+    """Tiles a slot's k-loop visits: ``ceil((L + T)/block_k)``, clamped
+    to the buffer's tile count. Host-side mirror of the in-kernel bound
+    — the serve scheduler derives its ``decode_blocks_skipped`` obs
+    counter from this, and tests pin it against the kernel's own count.
+    Works on numpy or jax int arrays."""
+    total = s // block_k
+    n = (lengths + t_q + block_k - 1) // block_k
+    return jnp.clip(n, 1, total) if hasattr(n, "aval") else n.clip(1, total)
+
+
+# ---------------------------------------------------------------------------
+# Kernel. One grid program per slot; K/V stay in HBM and are DMA'd
+# tile-by-tile (double-buffered) so skipped tiles are never read.
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    lengths_ref,  # [B] int32, SMEM (whole array; indexed by program)
+    q_ref,        # [1, T, H·D] VMEM tile
+    k_hbm,        # [B, S, H·D] ANY/HBM (full array)
+    v_hbm,
+    o_ref,        # [1, T, H·D] VMEM tile
+    visited_ref,  # [1, 1] int32 SMEM — tiles this program actually ran
+    k_buf,        # [2, block_k, H·D] VMEM scratch
+    v_buf,
+    sem,          # [2, 2] DMA semaphores (k/v × buffer slot)
+    *,
+    block_k,
+    num_heads,
+    head_dim,
+    scale,
+):
+    b = pl.program_id(0)
+    t_q = q_ref.shape[1]
+    s = k_hbm.shape[1]
+    h_n, d = num_heads, head_dim
+    length = lengths_ref[b]
+
+    # Tiles with >= 1 visible key: ceil((L + T)/block_k), clamped to the
+    # buffer (a stale/retired slot's length can never overrun it).
+    n_k = jnp.clip((length + t_q + block_k - 1) // block_k, 1, s // block_k)
+    visited_ref[0, 0] = n_k
+
+    def dma(which_hbm, which_buf, sem_row, slot, ki):
+        return pltpu.make_async_copy(
+            which_hbm.at[b, pl.ds(ki * block_k, block_k)],
+            which_buf.at[slot],
+            sem.at[sem_row, slot],
+        )
+
+    dma(k_hbm, k_buf, 0, 0, 0).start()
+    dma(v_hbm, v_buf, 1, 0, 0).start()
+
+    t_pos = length + lax.broadcasted_iota(jnp.int32, (t_q, block_k), 0)
+
+    def body(ki, carry):
+        slot = lax.rem(ki, 2)
+
+        @pl.when(ki + 1 < n_k)
+        def _prefetch():
+            dma(k_hbm, k_buf, 0, 1 - slot, ki + 1).start()
+            dma(v_hbm, v_buf, 1, 1 - slot, ki + 1).start()
+
+        dma(k_hbm, k_buf, 0, slot, ki).wait()
+        dma(v_hbm, v_buf, 1, slot, ki).wait()
+
+        k_pos = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (t_q, block_k), 1
+        )
+        vis = t_pos >= k_pos  # key j visible to query t iff j <= L + t
+        out = []
+        for h in range(h_n):
+            m, l, acc = carry[3 * h], carry[3 * h + 1], carry[3 * h + 2]
+            # Matmul operands stay in the INPUT dtype (bf16 serving path)
+            # with f32 accumulation; softmax statistics stay f32 and the
+            # scale folds into the f32 scores (training-kernel idiom).
+            q = q_ref[0, :, h * d : (h + 1) * d]  # [T, d]
+            k_blk = k_buf[slot, :, h * d : (h + 1) * d]  # [bk, d]
+            v_blk = v_buf[slot, :, h * d : (h + 1) * d]
+            sc = lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [T, bk] f32
+            sc = jnp.where(vis, sc, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(sc, axis=1))
+            p = jnp.exp(sc - m_new[:, None])  # masked cols: exactly 0.0
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + jnp.sum(p, axis=1)
+            acc_new = alpha[:, None] * acc + lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            out += [m_new, l_new, acc_new]
+        return tuple(out)
+
+    init = []
+    for _ in range(h_n):
+        init += [
+            jnp.full((t_q,), _NEG_INF, jnp.float32),
+            jnp.zeros((t_q,), jnp.float32),
+            jnp.zeros((t_q, d), jnp.float32),
+        ]
+    carry = lax.fori_loop(0, n_k, body, tuple(init))
+
+    for h in range(h_n):
+        l = carry[3 * h + 1]
+        acc = carry[3 * h + 2]
+        # Key 0 is visible to every query (L >= 0), so no row is ever
+        # fully masked; the guard only keeps a malformed call finite.
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, :, h * d : (h + 1) * d] = (
+            acc / l_safe[:, None]
+        ).astype(o_ref.dtype)
+
+
+def _vma(x):
+    # Inside a VMA-checked shard_map, pallas_call out_shapes must declare
+    # how outputs vary across mesh axes; mirror the query operand's vma.
+    return getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+def _decode_call(q, k, v, lengths, *, block_k, interpret):
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    hd = h * d
+    pk = lambda x: x.reshape(x.shape[0], x.shape[1], hd)  # free head-pack
+    kern = functools.partial(
+        _decode_kernel,
+        block_k=block_k,
+        num_heads=h,
+        head_dim=d,
+        scale=1.0 / (d ** 0.5),
+    )
+    o, visited = pl.pallas_call(
+        kern,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths, whole [B]
+            pl.BlockSpec(
+                (1, t, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # K stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),  # V stays in HBM
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, t, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t, hd), q.dtype, vma=_vma(q)),
+            jax.ShapeDtypeStruct((b, 1), jnp.int32, vma=_vma(q)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, block_k, hd), k.dtype),
+            pltpu.VMEM((2, block_k, hd), v.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+        interpret=bool(interpret),
+    )(jnp.asarray(lengths, jnp.int32), pk(q), pk(k), pk(v))
+    return o.reshape(b, t, h, d), visited[:, 0]
+
+
+def flash_decode_attention(
+    q,
+    k,
+    v,
+    lengths,
+    *,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+    return_visited: bool = False,
+):
+    """Length-aware cached attention: ``[B, T, H, Dh]`` queries (the T
+    newest positions, global position ``lengths + t``) against padded
+    ``[B, S, H, Dh]`` K/V cache buffers.
+
+    Drop-in for :func:`mpit_tpu.models.gpt2.cached_attention` (plug in
+    as ``GPT2Config.cache_attention_fn``). ``block_k`` tiles the cache
+    length (default via :func:`pick_block_k`: largest power of two
+    ≤ 256 dividing S that yields at least 4 tiles, floor 8); a slot
+    holding ``L`` tokens visits ``ceil((L+T)/block_k)`` tiles.
+
+    ``interpret``: ``None`` = Pallas kernel on TPU, reference XLA path
+    elsewhere; ``True`` = force the kernel through the interpreter (the
+    CPU test path); ``False`` = force it compiled.
+
+    ``return_visited``: also return the per-slot visited-tile count
+    ``[B] int32`` — on the kernel path this is written by the kernel
+    itself (what the loop actually ran), on the reference path it is the
+    host formula :func:`num_kv_blocks`; tests pin the two against each
+    other.
+    """
+    s = k.shape[1]
+    bk = pick_block_k(s, block_k)
+    if s % bk:
+        # Validated on EVERY platform (the reference fallback could run
+        # any block_k, but its visited-tile accounting would describe a
+        # tiling the kernel can't execute — code passing off-TPU must
+        # not first fail at TPU deploy).
+        raise ValueError(
+            f"cache length {s} must be divisible by block_k={bk}"
+        )
+    if not _use_kernel(interpret):
+        out = reference_decode_attention(q, k, v, lengths)
+        if return_visited:
+            return out, num_kv_blocks(
+                jnp.asarray(lengths, jnp.int32), q.shape[1], s, bk
+            )
+        return out
+    out, visited = _decode_call(
+        q, k, v, lengths, block_k=bk,
+        interpret=bool(interpret) if interpret is not None else False,
+    )
+    return (out, visited) if return_visited else out

@@ -212,3 +212,134 @@ def lm_head_xent(
 
 def _round_up(x: int, m: int) -> int:
     return x + (-x) % m
+
+
+# ---------------------------------------------------------------------------
+# Blocked decode head: greedy / top-k / temperature sampling straight from
+# hiddens, streaming over vocab blocks (ISSUE 5). The serving engine's
+# decode step used to materialize the full [slots, vocab] f32 logits just
+# to pick one token per slot; this computes the pick per vocab block with
+# a running top-k merge, so the live tile is [slots, block] — the same
+# trick lm_head_xent plays for training, applied to sampling.
+# ---------------------------------------------------------------------------
+
+
+def lm_head_sample(
+    h,
+    head,
+    key,
+    temperature,
+    top_k,
+    *,
+    block_size: int = 8192,
+    k_cap: int = 128,
+    compute_dtype=jnp.float32,
+):
+    """Sample one token per row from ``softmax(h @ headᵀ)`` without ever
+    materializing the ``[rows, vocab]`` logits.
+
+    Args:
+      h: ``[S, d_model]`` final hidden states (the decode positions).
+      head: ``[vocab, d_model]`` LM-head / tied-embedding weight.
+      key: PRNG key; block ``i`` draws its Gumbel noise from
+        ``fold_in(key, i)`` — the per-block derivation IS the sampling
+        contract (the full-logits oracle in tests reproduces it
+        exactly), replacing ``jax.random.categorical``'s monolithic
+        ``[S, vocab]`` field which cannot be drawn blockwise.
+      temperature: ``[S]`` f32; ``<= 0`` selects greedy for that row.
+      top_k: ``[S]`` int32; ``> 0`` restricts sampling to the k
+        highest-logit tokens (``0`` = full vocab). Must be ``<= k_cap``
+        (the static running-buffer width) — the engine validates at
+        submit time.
+      block_size / compute_dtype: as :func:`lm_head_xent` — the live
+        logits tile is ``[S, block]`` f32, matmul operands in
+        ``compute_dtype`` with f32 accumulation.
+      k_cap: static width of the running top-k candidate buffer.
+
+    Per vocab block the scan carries (1) the running argmax of the raw
+    logits — greedy bit-matches ``argmax`` over the full logits because
+    the strict-``>`` merge keeps the first occurrence, exactly
+    ``jnp.argmax``'s tie rule; (2) the running argmax of
+    ``logit/temp + gumbel`` — exact full-vocab categorical via the
+    Gumbel-max trick; (3) the top-``k_cap`` (value, index, noised-score)
+    triples merged across blocks — the final top-k draw thresholds at
+    the k-th largest value *inside the buffer* and Gumbel-argmaxes the
+    survivors, so no second pass over the vocabulary is needed.
+
+    Returns ``[S]`` int32 token ids.
+    """
+    vocab, d = head.shape
+    block = min(block_size, _round_up(vocab, 128))
+    pad = (-vocab) % block
+    if pad:
+        head = jnp.concatenate(
+            [head, jnp.zeros((pad, d), head.dtype)], axis=0
+        )
+    n_blocks = head.shape[0] // block
+    head_blocks = head.reshape(n_blocks, block, head.shape[1])
+    offsets = jnp.arange(n_blocks, dtype=jnp.int32) * block
+    blk_ids = jnp.arange(n_blocks, dtype=jnp.int32)
+    n = h.shape[0]
+    kb = min(k_cap, vocab)
+    temp = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    cd = jnp.dtype(compute_dtype)
+
+    def tick(carry, xs):
+        gv, gi, sv, si, bv, bi, bs = carry
+        head_b, off, blk = xs
+        valid = off + jnp.arange(block, dtype=jnp.int32) < vocab
+        logits = _block_logits(h, head_b, valid, cd)  # [S, block] f32
+        # (1) greedy: strict > keeps the FIRST max — jnp.argmax's rule.
+        bm = jnp.max(logits, axis=-1)
+        bmi = jnp.argmax(logits, axis=-1).astype(jnp.int32) + off
+        upd = bm > gv
+        gv, gi = jnp.where(upd, bm, gv), jnp.where(upd, bmi, gi)
+        # (2) full-vocab Gumbel-max on temperature-scaled logits.
+        g = jax.random.gumbel(
+            jax.random.fold_in(key, blk), (n, block), jnp.float32
+        )
+        scaled = jnp.where(
+            valid[None, :], logits / temp[:, None] + g, _NEG_BIG
+        )
+        sm = jnp.max(scaled, axis=-1)
+        smi = jnp.argmax(scaled, axis=-1).astype(jnp.int32) + off
+        supd = sm > sv
+        sv, si = jnp.where(supd, sm, sv), jnp.where(supd, smi, si)
+        # (3) running top-k candidates: merge this block's top-kb
+        # (value, global index, noised score) into the buffer.
+        cv, ci = lax.top_k(logits, min(kb, block))
+        cs = jnp.take_along_axis(scaled, ci, axis=-1)
+        allv = jnp.concatenate([bv, cv], axis=-1)
+        alli = jnp.concatenate([bi, ci + off], axis=-1)
+        alls = jnp.concatenate([bs, cs], axis=-1)
+        bv, sel = lax.top_k(allv, kb)
+        bi = jnp.take_along_axis(alli, sel, axis=-1)
+        bs = jnp.take_along_axis(alls, sel, axis=-1)
+        return (gv, gi, sv, si, bv, bi, bs), None
+
+    neg = jnp.full((n,), -jnp.inf, jnp.float32)
+    zero_i = jnp.zeros((n,), jnp.int32)
+    init = (
+        neg, zero_i,  # greedy running (max, argmax)
+        neg, zero_i,  # full-vocab gumbel running (max, argmax)
+        jnp.full((n, kb), _NEG_BIG, jnp.float32),  # top-k values
+        jnp.zeros((n, kb), jnp.int32),  # top-k global indices
+        jnp.full((n, kb), _NEG_BIG, jnp.float32),  # top-k noised scores
+    )
+    (gv, gi, sv, si, bv, bi, bs), _ = lax.scan(
+        tick, init, (head_blocks, offsets, blk_ids),
+        unroll=min(n_blocks, 16),
+    )
+    # Top-k draw: threshold at the row's k-th largest value inside the
+    # buffer (reference semantics: keep logits >= thresh), Gumbel-argmax
+    # the survivors.
+    kk = jnp.clip(jnp.asarray(top_k, jnp.int32), 1, kb)
+    thresh = jnp.take_along_axis(bv, (kk - 1)[:, None], axis=-1)
+    kept = jnp.where(bv >= thresh, bs, -jnp.inf)
+    tk_tok = jnp.take_along_axis(
+        bi, jnp.argmax(kept, axis=-1)[:, None], axis=-1
+    )[:, 0]
+    top_k = jnp.asarray(top_k, jnp.int32)
+    sampled = jnp.where(top_k > 0, tk_tok, si)
+    greedy = jnp.asarray(temperature, jnp.float32) <= 0.0
+    return jnp.where(greedy, gi, sampled).astype(jnp.int32)

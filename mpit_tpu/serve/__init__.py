@@ -15,7 +15,14 @@ per-slot KV cache and the request loop is continuous batching.
   the engine's lifetime); per-slot greedy/temperature/top-k sampling
   jitted with the step; a TP variant reusing the ``parallel.megatron``
   block rules. Greedy outputs bit-match the no-cache ``models.gpt2``
-  forward.
+  forward. The hot loop is kernel-shaped (ISSUE 5): attention runs the
+  Pallas flash-decode kernel (:mod:`mpit_tpu.ops.decode_attention` —
+  blocked over the cache length, per-slot length-aware tile skipping)
+  and sampling streams the LM head per vocab block
+  (:func:`mpit_tpu.ops.lm_head.lm_head_sample`) — the decode step
+  never materializes ``[slots, vocab]`` logits or ``[slots, H, T,
+  max_len]`` scores; ``Engine(decode_attention="reference")`` keeps
+  the dense PR 4 path as the parity oracle.
 - :mod:`~mpit_tpu.serve.scheduler` — the continuous-batching loop:
   queue → admit into freed slots between decode ticks → per-slot
   retirement (EOS / max tokens / cache full), with full ``obs``

@@ -38,6 +38,15 @@ class ServeConfig:
     max_new_tokens: int = 16
     temperature: float = 0.0  # <=0 greedy
     top_k: int = 0  # 0 = full vocab
+    # Serving hot-loop implementation (ISSUE 5): kernel = Pallas
+    # flash-decode + blocked LM-head sampling (reference fallback off
+    # TPU); reference = the dense PR 4 path; interpret = force the
+    # kernel through the Pallas interpreter (CPU testing).
+    decode_attention: str = "kernel"
+    # Blocked sampler's candidate-buffer width — bounds --top-k under
+    # kernel/interpret modes (submit rejects top_k > this). Grown here
+    # so the remedy the rejection names is reachable from the CLI.
+    sample_k_cap: int = 128
     mesh: str = ""  # e.g. "model=2" -> TP engine over that axis
     sentinel: bool = False  # decode/prefill tick anomaly sentinel
     trace: str = ""  # write a Chrome trace of the run here
@@ -83,6 +92,8 @@ def _build_engine(cfg: ServeConfig):
         world=world,
         tp_axis=tp_axis,
         seed=cfg.seed,
+        decode_attention=cfg.decode_attention,
+        sample_k_cap=max(cfg.sample_k_cap, cfg.top_k),
     )
     return engine, mcfg
 
@@ -140,9 +151,14 @@ def main(argv: list[str] | None = None) -> dict:
         "decode_tokens_per_sec": (
             round(decode_tokens / decode_s, 2) if decode_s else None
         ),
+        "decode_attention": engine.decode_attention_mode,
+        "decode_sampler": engine.decode_sampler,
         **stats,
         "obs_summary": {
-            name: {k: round(v, 6) for k, v in p.items()}
+            name: {
+                k: round(v, 6) if isinstance(v, float) else v
+                for k, v in p.items()
+            }
             for name, p in summ["phases"].items()
         },
     }

@@ -33,6 +33,7 @@ observability around them.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any
 
@@ -46,9 +47,20 @@ from mpit_tpu.models.gpt2 import (
     cache_update,
     cached_attention,
 )
+from mpit_tpu.ops.decode_attention import flash_decode_attention, pick_block_k
+from mpit_tpu.ops.lm_head import lm_head_sample
 from mpit_tpu.serve.kvcache import KVCache, alloc_cache, cache_specs
 
 __all__ = ["Engine", "sample_tokens"]
+
+# Engine.decode_attention values. "kernel" = the Pallas flash-decode path
+# (ISSUE 5) where available — on non-TPU backends the kernel call falls
+# back to the reference math, and decode_attention_mode says so;
+# "interpret" forces the kernel through the Pallas interpreter (the CPU
+# parity-test path); "reference" = the PR 4 hot loop unchanged (dense
+# cached_attention + materialized-logits sampling), kept as the parity
+# oracle and the perf comparison baseline.
+_DECODE_MODES = ("kernel", "interpret", "reference")
 
 
 def sample_tokens(logits, key, temperature, top_k):
@@ -80,7 +92,10 @@ def sample_tokens(logits, key, temperature, top_k):
 # ---------------------------------------------------------------------------
 
 
-def _tp_cache_forward(params, tokens, cache: KVCache, *, cfg, axis):
+def _tp_cache_forward(
+    params, tokens, cache: KVCache, *, cfg, axis, attn_fn=None,
+    with_head=True,
+):
     """Cache-aware GPT-2 forward INSIDE shard_map over the TP axis.
 
     The per-device view: block matmul kernels arrive sharded per
@@ -115,7 +130,11 @@ def _tp_cache_forward(params, tokens, cache: KVCache, *, cfg, axis):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         k_i = cache_update(cache.k[i], split(k), cache.lengths)
         v_i = cache_update(cache.v[i], split(v), cache.lengths)
-        attn = cached_attention(split(q), k_i, v_i, cache.lengths)
+        # Heads-local by construction (kernel or reference): this
+        # device's H/P head shard of the cache goes in unchanged.
+        attn = (attn_fn or cached_attention)(
+            split(q), k_i, v_i, cache.lengths
+        )
         attn = attn.reshape(*attn.shape[:-2], -1)
         x = x + M.row_parallel_dense(
             attn,
@@ -139,6 +158,14 @@ def _tp_cache_forward(params, tokens, cache: KVCache, *, cfg, axis):
         new_v.append(v_i)
 
     x = M.layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    new_cache = KVCache(
+        k=jnp.stack(new_k), v=jnp.stack(new_v), lengths=cache.lengths
+    )
+    if not with_head:
+        # Blocked decode head: the replicated post-ln_f hiddens go back
+        # to the jitted step, which samples via lm_head_sample — no
+        # [B, T, vocab] logits here either.
+        return x, new_cache
     head = params.get("head", params["wte"])
     logits = jnp.einsum(
         "btd,vd->btv",
@@ -146,9 +173,7 @@ def _tp_cache_forward(params, tokens, cache: KVCache, *, cfg, axis):
         head.astype(cfg.head_dtype),
         preferred_element_type=jnp.float32,
     )
-    return logits, KVCache(
-        k=jnp.stack(new_k), v=jnp.stack(new_v), lengths=cache.lengths
-    )
+    return logits, new_cache
 
 
 def _tp_param_specs(cfg, params, axis: str):
@@ -189,13 +214,70 @@ class Engine:
         world=None,
         tp_axis: str | None = None,
         seed: int = 0,
+        decode_attention: str = "kernel",
+        decode_block_k: int | None = None,
+        sample_block: int = 8192,
+        sample_k_cap: int = 128,
     ):
+        if decode_attention not in _DECODE_MODES:
+            raise ValueError(
+                f"decode_attention must be one of {_DECODE_MODES}, got "
+                f"{decode_attention!r}"
+            )
         self.cfg = cfg
         self.slots = slots
         self.max_len = min(max_len or cfg.max_seq_len, cfg.max_seq_len)
         self.prefill_len = min(prefill_len or self.max_len, self.max_len)
         self.tp_axis = tp_axis
         self._key = jax.random.key(seed)
+
+        # -- serving hot-loop shape (ISSUE 5): attention kernel + head --
+        self.decode_attention = decode_attention
+        self.decode_block_k = pick_block_k(self.max_len, decode_block_k)
+        if self.max_len % self.decode_block_k:
+            # Fail at construction, not at the first traced prefill —
+            # and never let the reference fallback run with tile
+            # accounting (skip counter, bench kv_blocks_*) that doesn't
+            # describe a real tiling.
+            raise ValueError(
+                f"decode_block_k={self.decode_block_k} does not divide "
+                f"max_len={self.max_len}; pick a divisor or omit it for "
+                "the auto choice"
+            )
+        self._sample_block = sample_block
+        platform = jax.devices()[0].platform
+        if decode_attention == "reference":
+            attn_fn = None  # cached_attention — the PR 4 path verbatim
+            self.decode_attention_mode = "reference"
+            self._blocked_head = False
+        else:
+            interp = True if decode_attention == "interpret" else None
+            attn_fn = functools.partial(
+                flash_decode_attention,
+                block_k=self.decode_block_k,
+                interpret=interp,
+            )
+            # The label obs attaches to decode spans: what actually
+            # executes — "kernel" mode off-TPU runs the reference
+            # fallback, and the flight recorder must be able to
+            # attribute a serve regression to exactly that.
+            self.decode_attention_mode = (
+                "kernel" if (interp or platform == "tpu") else "reference"
+            )
+            self._blocked_head = True
+        # Blocked sampling bounds top_k by the static candidate-buffer
+        # width; the scheduler validates at submit. None = dense path,
+        # no bound.
+        self.sample_k_cap = sample_k_cap if self._blocked_head else None
+        # The head is pure XLA, so off-TPU "kernel" mode keeps the
+        # blocked sampler even though attention falls back — the mode
+        # label alone does NOT pin the whole hot-loop shape, this does:
+        # attention=reference + sampler=blocked is the fallback engine,
+        # attention=reference + sampler=dense is the true PR 4 path.
+        self.decode_sampler = "blocked" if self._blocked_head else "dense"
+        if attn_fn is not None:
+            cfg = dataclasses.replace(cfg, cache_attention_fn=attn_fn)
+            self.cfg = cfg  # what the forward really runs, kernel included
 
         sharding = None
         if tp_axis is not None:
@@ -225,7 +307,10 @@ class Engine:
             cs = cache_specs(tp_axis)
             sharding = world.sharding(*cs.k)
             fwd = world.shard_map(
-                functools.partial(_tp_cache_forward, cfg=cfg, axis=tp_axis),
+                functools.partial(
+                    _tp_cache_forward, cfg=cfg, axis=tp_axis,
+                    attn_fn=attn_fn, with_head=not self._blocked_head,
+                ),
                 in_specs=(self._specs, jax.sharding.PartitionSpec(), cs),
                 out_specs=(jax.sharding.PartitionSpec(), cs),
             )
@@ -233,12 +318,15 @@ class Engine:
             model = GPT2(cfg)
 
             def fwd(prms, tokens, cache: KVCache):
-                logits, (k2, v2) = model.apply(
+                # Blocked head: the forward ends at ln_f and the step
+                # samples from hiddens; dense: logits as in PR 4.
+                out, (k2, v2) = model.apply(
                     {"params": prms},
                     tokens,
                     cache=(cache.k, cache.v, cache.lengths),
+                    return_hidden=self._blocked_head,
                 )
-                return logits, KVCache(k=k2, v=v2, lengths=cache.lengths)
+                return out, KVCache(k=k2, v=v2, lengths=cache.lengths)
 
         self.params = params
         self.cache = alloc_cache(
@@ -250,6 +338,24 @@ class Engine:
         self._decode_jit = jax.jit(self._decode_step)
 
     # -- jitted step bodies -------------------------------------------------
+    def _sample_last(self, params, out, gather_idx, key, temp, topk):
+        """Token per slot from the forward's output at ``gather_idx``
+        — blocked path: gather the HIDDEN row and stream the head
+        (:func:`lm_head_sample`, no [slots, vocab] array); dense path:
+        gather the logits row and sample as in PR 4."""
+        row = jnp.take_along_axis(
+            out, gather_idx[:, None, None], axis=1
+        )[:, 0]
+        if not self._blocked_head:
+            return sample_tokens(row.astype(jnp.float32), key, temp, topk)
+        head = params["head"] if "head" in params else params["wte"]
+        return lm_head_sample(
+            row, head, key, temp, topk,
+            block_size=self._sample_block,
+            k_cap=self.sample_k_cap,
+            compute_dtype=self.cfg.head_dtype,
+        )
+
     def _prefill_step(
         self, params, cache, last, tokens, prompt_lens, admit, key, temp, topk
     ):
@@ -259,13 +365,10 @@ class Engine:
         fresh = KVCache(
             k=cache.k, v=cache.v, lengths=jnp.zeros_like(cache.lengths)
         )
-        logits, new = self._forward(params, tokens, fresh)
-        first = jnp.take_along_axis(
-            logits,
-            jnp.maximum(prompt_lens - 1, 0)[:, None, None],
-            axis=1,
-        )[:, 0].astype(jnp.float32)
-        tok = sample_tokens(first, key, temp, topk)
+        out, new = self._forward(params, tokens, fresh)
+        tok = self._sample_last(
+            params, out, jnp.maximum(prompt_lens - 1, 0), key, temp, topk
+        )
         sel = admit[None, :, None, None, None]
         return (
             KVCache(
@@ -278,19 +381,26 @@ class Engine:
 
     def _decode_step(self, params, cache, last, active, key, temp, topk):
         """One decode tick: append each active slot's last token at its
-        current length, sample the next from the new final logits."""
-        logits, new = self._forward(params, last[:, None], cache)
-        tok = sample_tokens(
-            logits[:, -1].astype(jnp.float32), key, temp, topk
+        current length, sample the next from the new final output row."""
+        # Inactive slots are FREE slots (every live slot is active every
+        # tick) — clamp their lengths to 0 before the forward, or the
+        # length-aware kernel keeps paying a retired request's
+        # near-full-context tiles for an empty slot on every tick. Their
+        # compute was always discarded (write-back below is masked);
+        # this makes it 1 tile instead of ceil(stale_L/block_k).
+        lens = jnp.where(active, cache.lengths, 0)
+        cache = KVCache(k=cache.k, v=cache.v, lengths=lens)
+        out, new = self._forward(params, last[:, None], cache)
+        tok = self._sample_last(
+            params, out,
+            jnp.zeros((out.shape[0],), jnp.int32), key, temp, topk,
         )
         sel = active[None, :, None, None, None]
         return (
             KVCache(
                 k=jnp.where(sel, new.k, cache.k),
                 v=jnp.where(sel, new.v, cache.v),
-                lengths=jnp.where(
-                    active, cache.lengths + 1, cache.lengths
-                ),
+                lengths=jnp.where(active, lens + 1, lens),
             ),
             jnp.where(active, tok, last),
         )

@@ -36,6 +36,7 @@ from typing import Any
 import numpy as np
 
 from mpit_tpu import obs
+from mpit_tpu.ops.decode_attention import num_kv_blocks
 
 __all__ = ["Request", "Completed", "Server"]
 
@@ -94,6 +95,16 @@ class Server:
     def __init__(self, engine, *, sentinel=None):
         self.engine = engine
         self.sentinel = sentinel
+        # The attention mode + sampler actually executing — stamped on
+        # every prefill/decode span so the flight recorder / sentinel can
+        # attribute a serve-path regression to a kernel fallback (ISSUE 5
+        # obs satellite). Both labels matter: off-TPU "kernel" mode runs
+        # reference ATTENTION but keeps the blocked SAMPLER, so
+        # attention=reference alone does not identify the PR 4 path.
+        self._attn_mode = getattr(
+            engine, "decode_attention_mode", "reference"
+        )
+        self._sampler = getattr(engine, "decode_sampler", "dense")
         self.queue: deque[_Live] = deque()
         self.live: dict[int, _Live] = {}  # slot -> in-flight request
         self.free: list[int] = list(range(engine.slots))[::-1]  # pop() = slot 0 first
@@ -127,6 +138,14 @@ class Server:
                 f"({len(req.prompt)} + {req.max_new_tokens}) exceeds the "
                 f"engine's max_len {self.engine.max_len}"
             )
+        k_cap = getattr(self.engine, "sample_k_cap", None)
+        if k_cap is not None and req.top_k > k_cap:
+            raise ValueError(
+                f"request {req.rid!r}: top_k {req.top_k} exceeds the "
+                f"blocked sampler's candidate buffer (sample_k_cap="
+                f"{k_cap}); raise Engine(sample_k_cap=...) or use "
+                f"top_k=0 (full vocab)"
+            )
         self.queue.append(_Live(req, time.perf_counter()))
 
     # -- the loop -----------------------------------------------------------
@@ -152,7 +171,10 @@ class Server:
             self._topk[slot] = live.req.top_k
             obs.span_at("queue_wait", live.submit_t, now, rid=live.req.rid)
             batch.append((slot, live))
-        with obs.span("prefill", admitted=len(batch)):
+        with obs.span(
+            "prefill", admitted=len(batch), attention=self._attn_mode,
+            sampler=self._sampler,
+        ):
             first = self.engine.prefill(
                 tokens, lens, admit, self._temp, self._topk
             )
@@ -214,12 +236,44 @@ class Server:
         for slot in self.live:
             active[slot] = True
         t0 = time.perf_counter()
-        with obs.span("decode", active=int(active.sum())):
+        with obs.span(
+            "decode", active=int(active.sum()), attention=self._attn_mode,
+            sampler=self._sampler,
+        ):
             toks = self.engine.decode(active, self._temp, self._topk)
         now = time.perf_counter()
         if self.sentinel is not None:
             self.sentinel.observe_phases(self.tick, decode=now - t0)
         obs.counter("serve_tokens", float(active.sum()))
+        if self._attn_mode == "kernel" and self.live:
+            # Cache tiles the length-aware kernel skipped this tick —
+            # ONE formula, num_kv_blocks, shared with the kernel's own
+            # in-kernel bound (pinned against it in
+            # tests/test_decode_attention.py), so the counter cannot
+            # drift from what the kernel actually visits. A serve
+            # regression with this counter flat at 0 = kernel fallback.
+            # The decode step runs over ALL slots: free slots' lengths
+            # are clamped to 0 in-step, so each one visits exactly 1
+            # tile — counted here too, or the counter would understate
+            # the skipping the clamp buys.
+            bk = self.engine.decode_block_k
+            total = self.engine.max_len // bk
+            lens = np.asarray(
+                [
+                    len(live.req.prompt) + len(live.tokens) - 1
+                    for live in self.live.values()
+                ]
+            )
+            visited = num_kv_blocks(lens, 1, self.engine.max_len, bk)
+            n_free = self.engine.slots - lens.size
+            obs.counter(
+                "decode_blocks_skipped",
+                float(
+                    total * self.engine.slots
+                    - int(visited.sum())
+                    - n_free  # 1 visited tile per clamped free slot
+                ),
+            )
         for slot in list(self.live):
             self.live[slot].tokens.append(int(toks[slot]))
             self._maybe_retire(slot, now)

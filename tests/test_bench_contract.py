@@ -131,6 +131,9 @@ def _realistic_results():
         },
         "gpt2_serve": {
             "decode_tokens_per_sec": 123456.7,
+            "decode_attention": "reference",
+            "decode_sampler": "blocked",
+            "reference_decode_tokens_per_sec": 98765.4,
             "serve_tokens_per_sec": 98765.4,
             "latency_p50_s": 1.234567,
             "latency_p95_s": 2.345678,
@@ -143,6 +146,17 @@ def _realistic_results():
             "max_new_tokens": 48,
             "ticks": 144,
             "occupancy_mean": 0.9583,
+            "decode_sweep": {
+                "config": {"num_layers": 2, "d_model": 768, "slots": 4,
+                           "max_new": 8, "max_len": 1040, "block_k": 16,
+                           "decode_attention": "kernel"},
+                "points": [
+                    {"context_len": c, "decode_tokens_per_sec": 12345.6,
+                     "kv_blocks_visited_per_slot": 4,
+                     "kv_blocks_total": 65}
+                    for c in (64, 256, 1024)
+                ],
+            },
             "phases": phases,
             "obs_baseline": obs_baseline,
         },
@@ -204,15 +218,21 @@ class TestLineBudget:
         assert rec["detail"]["allreduce"]["modeled"] is True
         assert "by_payload_mb" not in rec["detail"]["allreduce"]
         # The serving workload (ISSUE 4): decode tokens/s + request
-        # latency p50/p95 ride the line; TTFT percentiles, occupancy and
-        # stream geometry are detail-file-only.
+        # latency p50/p95 ride the line — joined by the resolved
+        # decode-attention mode (ISSUE 5: kernel vs reference fallback
+        # must be attributable from the record alone); TTFT percentiles,
+        # occupancy, stream geometry, the kernel-off A-B number and the
+        # context-length sweep are detail-file-only.
         serve = rec["detail"]["gpt2_serve"]
         assert serve["decode_tokens_per_sec"] == 123456.7
+        assert serve["decode_attention"] == "reference"
         assert serve["latency_p50_s"] == 1.234567
         assert serve["latency_p95_s"] == 2.345678
         for off_line in ("ttft_p50_s", "ttft_p95_s", "occupancy_mean",
                         "generated_tokens", "serve_tokens_per_sec",
-                        "prompt_len", "ticks"):
+                        "prompt_len", "ticks", "decode_sweep",
+                        "decode_sampler",
+                        "reference_decode_tokens_per_sec"):
             assert off_line not in serve
         # The obs phase breakdown is detail-file-only too (ISSUE 1), and
         # so are the gap ATTRIBUTION (the line carries only the pct),

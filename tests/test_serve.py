@@ -316,6 +316,234 @@ class TestTensorParallelEngine:
         }
 
 
+class TestFlashDecodeServing:
+    """ISSUE 5 acceptance: the PR 4 invariants survive the hot-loop swap
+    (flash-decode kernel + blocked LM-head sampling), and the decode
+    step's shape actually changed."""
+
+    def test_staggered_bitmatch_through_kernel(self, model_and_params):
+        """THE acceptance run again, forced through the Pallas kernel
+        (interpret mode on CPU) + blocked sampling: every request's
+        greedy output still equals its isolated no-cache run."""
+        model, params = model_and_params
+        engine = Engine(
+            CFG, params, slots=2, max_len=40, prefill_len=8,
+            decode_attention="interpret",
+        )
+        assert engine.decode_attention_mode == "kernel"
+        server = Server(engine)
+        for i, (p, n) in enumerate(zip(PROMPTS, MAX_NEW)):
+            server.submit(Request(rid=i, prompt=p, max_new_tokens=n))
+        done = server.run()
+        assert len(done) == len(PROMPTS)
+        assert server.admissions == len(PROMPTS) > engine.slots
+        for c in done:
+            assert c.tokens == ref_greedy(
+                model, params, c.prompt, len(c.tokens)
+            ), f"request {c.rid} diverged through the kernel"
+
+    def test_decode_clamps_free_slot_lengths(self, model_and_params):
+        """A freed slot's stale cache length must not survive into the
+        next decode tick — the length-aware kernel would keep paying the
+        retired request's tiles for an empty slot. The step clamps
+        inactive lengths to 0 (write-back discarded their compute
+        anyway), so a free slot costs exactly 1 tile."""
+        _, params = model_and_params
+        engine = Engine(
+            CFG, params, slots=2, max_len=40, prefill_len=8,
+            decode_attention="interpret",
+        )
+        server = Server(engine)
+        server.submit(Request(rid=0, prompt=[3, 1, 4], max_new_tokens=8))
+        server.submit(Request(rid=1, prompt=[2, 7], max_new_tokens=1))
+        server.run()
+        # rid=1 retired after one token; later ticks (rid=0 still live)
+        # ran decode with its slot inactive — its device length must be
+        # clamped, not left at the retired request's fill.
+        assert int(np.asarray(engine.cache.lengths)[1]) <= 1
+
+    def test_tp_engine_bitmatch_through_kernel(self, model_and_params):
+        """data=4 x model=2 fake mesh, kernel on the H/P head shard."""
+        model, params = model_and_params
+        world = mpit_tpu.init({"data": 4, "model": 2}, set_default=False)
+        engine = Engine(
+            CFG, params, slots=2, max_len=40, prefill_len=8,
+            world=world, tp_axis="model", decode_attention="interpret",
+        )
+        server = Server(engine)
+        for i, (p, n) in enumerate(zip(PROMPTS[:4], MAX_NEW[:4])):
+            server.submit(Request(rid=i, prompt=p, max_new_tokens=n))
+        done = server.run()
+        assert len(done) == 4
+        for c in done:
+            assert c.tokens == ref_greedy(
+                model, params, c.prompt, len(c.tokens)
+            ), f"TP request {c.rid} diverged through the kernel"
+
+    def test_kernel_mode_on_cpu_labels_reference_fallback(
+        self, model_and_params
+    ):
+        """decode_attention="kernel" off-TPU runs the reference math —
+        the mode label must say so (kernel-fallback attribution)."""
+        _, params = model_and_params
+        engine = Engine(CFG, params, slots=1, max_len=16, prefill_len=4)
+        assert engine.decode_attention == "kernel"
+        assert engine.decode_attention_mode == "reference"
+        # The fallback is NOT the PR 4 engine: the blocked sampler (pure
+        # XLA) stays active, and decode_sampler is the attribute that
+        # distinguishes the two "reference"-attention configurations.
+        assert engine.decode_sampler == "blocked"
+        # The cfg the engine stores is the cfg the forward runs — the
+        # kernel plug-in must be visible on it, not just traced in.
+        assert engine.cfg.cache_attention_fn is not None
+        eng_ref = Engine(
+            CFG, params, slots=1, max_len=16, prefill_len=4,
+            decode_attention="reference",
+        )
+        assert eng_ref.sample_k_cap is None  # dense head: no k bound
+        assert eng_ref.decode_sampler == "dense"
+        assert eng_ref.cfg.cache_attention_fn is None
+        with pytest.raises(ValueError, match="decode_attention"):
+            Engine(
+                CFG, params, slots=1, max_len=16, prefill_len=4,
+                decode_attention="pallas",
+            )
+
+    def test_decode_step_never_materializes_slot_vocab_logits(
+        self, model_and_params
+    ):
+        """The jaxpr pin (same style as the training LM-head): with the
+        blocked head, no [slots, vocab] (or [slots, 1, vocab]) f32
+        intermediate exists anywhere in the decode step — and no dense
+        [slots, H, 1, max_len] score tensor either on the kernel path.
+        The sampler's vocab block and candidate buffer are forced below
+        the (tiny test) vocab so the pin tests the BLOCKED shape — at
+        the real 50257 vocab the defaults (8192/128) are already sub-
+        vocab."""
+        _, params = model_and_params
+        from tests.test_decode_attention import _avals_with_shape
+
+        slots, max_len = 2, 32
+        engine = Engine(
+            CFG, params, slots=slots, max_len=max_len, prefill_len=8,
+            decode_attention="interpret", sample_block=32, sample_k_cap=16,
+        )
+        jx = jax.make_jaxpr(engine._decode_step)(
+            engine.params, engine.cache, engine.last_token,
+            jnp.ones((slots,), bool), jax.random.key(0),
+            jnp.zeros((slots,), jnp.float32), jnp.zeros((slots,), jnp.int32),
+        )
+        for shape in (
+            (slots, CFG.vocab_size),
+            (slots, 1, CFG.vocab_size),
+            (slots, CFG.num_heads, 1, max_len),
+        ):
+            hits = _avals_with_shape(jx.jaxpr, shape)
+            assert not hits, f"decode step materializes {shape}: {hits}"
+        # The dense reference DOES materialize both — the pin means
+        # something.
+        eng_ref = Engine(
+            CFG, params, slots=slots, max_len=max_len, prefill_len=8,
+            decode_attention="reference",
+        )
+        jx_ref = jax.make_jaxpr(eng_ref._decode_step)(
+            eng_ref.params, eng_ref.cache, eng_ref.last_token,
+            jnp.ones((slots,), bool), jax.random.key(0),
+            jnp.zeros((slots,), jnp.float32), jnp.zeros((slots,), jnp.int32),
+        )
+        assert _avals_with_shape(jx_ref.jaxpr, (slots, 1, CFG.vocab_size))
+
+    def test_sampling_modes_through_blocked_head(self, model_and_params):
+        """Temperature/top-k via lm_head_sample: reproducible under the
+        engine seed, valid ids, top_k=1 degenerates to greedy."""
+        model, params = model_and_params
+
+        def run(seed, temperature, top_k):
+            engine = Engine(
+                CFG, params, slots=2, max_len=32, prefill_len=8,
+                seed=seed, decode_attention="interpret",
+            )
+            server = Server(engine)
+            for i in range(3):
+                server.submit(
+                    Request(
+                        rid=i, prompt=PROMPTS[i], max_new_tokens=5,
+                        temperature=temperature, top_k=top_k,
+                    )
+                )
+            return {c.rid: c.tokens for c in server.run()}
+
+        a = run(0, 1.0, 0)
+        assert all(
+            0 <= t < CFG.vocab_size for toks in a.values() for t in toks
+        )
+        assert a == run(0, 1.0, 0), "same seed must reproduce"
+        b = run(3, 5.0, 1)
+        for rid, toks in b.items():
+            assert toks == ref_greedy(model, params, PROMPTS[rid], len(toks))
+
+    def test_submit_rejects_top_k_beyond_sample_cap(self, model_and_params):
+        _, params = model_and_params
+        engine = Engine(
+            CFG, params, slots=1, max_len=16, prefill_len=4,
+            sample_k_cap=8,
+        )
+        server = Server(engine)
+        with pytest.raises(ValueError, match="sample_k_cap"):
+            server.submit(
+                Request(rid=0, prompt=[1], max_new_tokens=2, top_k=9)
+            )
+        server.submit(  # at the cap is fine
+            Request(rid=1, prompt=[1], max_new_tokens=2, top_k=8)
+        )
+
+
+class TestServeKernelObservability:
+    """ISSUE 5 obs satellite: decode spans carry the attention-mode
+    label, and skipped cache tiles are counted."""
+
+    def test_decode_span_label_and_skip_counter(self, model_and_params):
+        _, params = model_and_params
+        rec = obs.Recorder()
+        with obs.local_recorder(rec):
+            engine = Engine(
+                CFG, params, slots=2, max_len=32, prefill_len=8,
+                decode_attention="interpret",
+            )
+            server = Server(engine)
+            for i in range(3):
+                server.submit(
+                    Request(rid=i, prompt=PROMPTS[i], max_new_tokens=4)
+                )
+            server.run()
+            summ = rec.summary()
+        assert summ["phases"]["decode"]["labels"]["attention"] == ["kernel"]
+        assert summ["phases"]["prefill"]["labels"]["attention"] == ["kernel"]
+        assert summ["phases"]["decode"]["labels"]["sampler"] == ["blocked"]
+        # Short contexts in a 32-row cache must have skipped tiles.
+        assert summ["counters"]["decode_blocks_skipped"] > 0
+
+    def test_reference_mode_labels_and_no_skip_counter(
+        self, model_and_params
+    ):
+        _, params = model_and_params
+        rec = obs.Recorder()
+        with obs.local_recorder(rec):
+            engine = Engine(
+                CFG, params, slots=1, max_len=32, prefill_len=8,
+                decode_attention="reference",
+            )
+            server = Server(engine)
+            server.submit(Request(rid=0, prompt=[5, 9], max_new_tokens=3))
+            server.run()
+            summ = rec.summary()
+        assert summ["phases"]["decode"]["labels"]["attention"] == [
+            "reference"
+        ]
+        assert summ["phases"]["decode"]["labels"]["sampler"] == ["dense"]
+        assert "decode_blocks_skipped" not in summ["counters"]
+
+
 class TestServeCLI:
     def test_cli_smoke_random_init(self):
         from mpit_tpu.serve.__main__ import main
@@ -332,6 +560,23 @@ class TestServeCLI:
         assert out["decode_tokens_per_sec"] > 0
         assert out["obs_summary"]["request_latency"]["count"] == 4
         assert out["sentinel"]["clean"] in (True, False)
+
+    def test_cli_top_k_beyond_default_cap(self):
+        """--top-k larger than the blocked sampler's default candidate
+        buffer must WORK from the CLI (the buffer sizes itself to the
+        stream's top_k) — the submit-time rejection is for Engine users
+        who set an explicit cap, not a CLI dead end."""
+        from mpit_tpu.serve.__main__ import main
+
+        out = main(
+            [
+                "--requests", "2", "--slots", "2", "--max-len", "48",
+                "--prefill-len", "8", "--max-new-tokens", "2",
+                "--temperature", "1.0", "--top-k", "200",
+            ]
+        )
+        assert out["requests_completed"] == 2
+        assert out["decode_sampler"] == "blocked"
 
     def test_cli_serves_dense_checkpoint(self, tmp_path, model_and_params):
         """The trained-checkpoint → serve path: save_dense → --ckpt."""
