@@ -699,7 +699,7 @@ def _serve_stream(
     import numpy as np
 
     from mpit_tpu import obs
-    from mpit_tpu.serve import Engine, Request, Server
+    from mpit_tpu.serve import Engine, Request, Server, warm_engine
 
     engine = Engine(
         cfg, params, slots=slots, max_len=max_len, prefill_len=prompt_len,
@@ -712,12 +712,7 @@ def _serve_stream(
         max_new_tokens=max_new,
     )
     with obs.span("warmup", calls=1):
-        warm = Server(engine)
-        warm.submit(
-            Request(rid=-1, prompt=make_req(-1).prompt, max_new_tokens=2)
-        )
-        warm.run()
-        engine.reset()
+        warm_engine(engine)
 
     server = Server(engine)
     for i in range(requests):
@@ -909,6 +904,205 @@ def bench_gpt2_serve(
     return out
 
 
+def bench_gpt2_slo(
+    slots: int = 4,
+    max_len: int = 64,
+    prefill_len: int = 16,
+    duration_s: float = 2.5,
+    rate_fractions: tuple = (0.4, 0.7, 1.0, 1.5),
+    ttft_multiple: float = 5.0,
+    window_s: float = 1.5,
+):
+    """The SLO sweep (ISSUE 6; ROADMAP item 4's headline metric): **max
+    sustained requests/s at p95 TTFT ≤ target**, measured by driving
+    the continuous-batching engine with OPEN-loop Poisson arrivals
+    (``serve.loadgen`` + ``Server.run_timed``) at a ladder of rates and
+    reading windowed percentiles off the streaming sketch
+    (``obs.stream``) — never the Recorder's bounded buffer.
+
+    Self-calibrating so the sweep means the same thing on CPU and TPU:
+
+    - **capacity** — a closed-loop saturation run measures the rate the
+      engine drains when arrival timing is no constraint; sweep rates
+      are ``rate_fractions`` of it, so the ladder straddles saturation
+      by construction and the top point OVERLOADS (its queue grows
+      without bound, TTFT explodes, the ``ttft_p95`` SLO trips —
+      ``slo_breach`` instants land in this workload's recorder and ride
+      its ``obs_baseline`` snapshot into BENCH_DETAIL.json);
+    - **ttft target** — ``ttft_multiple`` × the measured unloaded TTFT
+      (sequential single-request median): "p95 within 5× of an idle
+      server", an SLO that scales with the hardware instead of going
+      vacuous on a slow host.
+
+    A rate point is SUSTAINED when its whole-run sketch p95 TTFT meets
+    the target and the SLO monitor spent ≤ 20% of the window in breach.
+    The record line carries the headline + target + total breaches; the
+    rate → (p95 TTFT, tokens/s, breach fraction) curve is detail-only.
+    """
+    import numpy as np
+
+    import mpit_tpu
+    from mpit_tpu import obs
+    from mpit_tpu.models import GPT2, GPT2Config
+    from mpit_tpu.obs.slo import SLO, SLOMonitor
+    from mpit_tpu.obs.stream import StreamRegistry
+    from mpit_tpu.serve import (
+        Engine,
+        LoadSpec,
+        Request,
+        RequestClass,
+        Server,
+        generate_arrivals,
+        warm_engine,
+    )
+
+    world = mpit_tpu.init()
+    del world
+
+    cfg = GPT2Config.tiny(max_seq_len=max_len)
+    params = jax.jit(GPT2(cfg).init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = Engine(
+        cfg, params, slots=slots, max_len=max_len, prefill_len=prefill_len
+    )
+    mix = (
+        RequestClass("interactive", weight=0.8, prompt_len=(2, 10),
+                     max_new_tokens=(3, 8)),
+        RequestClass("batch", weight=0.2, prompt_len=(8, prefill_len - 2),
+                     max_new_tokens=(8, 20)),
+    )
+    mean_new = sum(
+        c.weight * (c.max_new_tokens[0] + c.max_new_tokens[1]) / 2
+        for c in mix
+    ) / sum(c.weight for c in mix)
+    rng = np.random.RandomState(0)
+
+    def _mk_req(i, klass):
+        # Inclusive [lo, hi], same convention as loadgen's sampler —
+        # the calibration requests and the sweep traffic must draw
+        # from the same distribution.
+        plen = int(rng.randint(klass.prompt_len[0], klass.prompt_len[1] + 1))
+        return Request(
+            rid=f"cal{i}",
+            prompt=rng.randint(0, cfg.vocab_size, size=plen).tolist(),
+            max_new_tokens=int(
+                rng.randint(klass.max_new_tokens[0],
+                            klass.max_new_tokens[1] + 1)
+            ),
+        )
+
+    with obs.span("warmup", calls=1):
+        warm_engine(engine)
+
+    # Calibration 1 — unloaded TTFT: sequential single requests on an
+    # idle engine; the SLO target's basis.
+    with obs.span("calibrate_ttft"):
+        ttfts = []
+        for i in range(5):
+            engine.reset()
+            s = Server(engine)
+            s.submit(_mk_req(i, mix[0]))
+            s.run()
+            ttfts.append(s.completed[0].ttft_s)
+        unloaded_ttft = float(np.median(ttfts))
+    ttft_target = ttft_multiple * unloaded_ttft
+
+    # Calibration 2 — closed-loop capacity: saturate the slots, measure
+    # the drain rate. Arrival timing can only LOWER throughput, so this
+    # is the ceiling the sweep fractions scale from.
+    with obs.span("calibrate_capacity"):
+        engine.reset()
+        s = Server(engine)
+        n_cal = slots * 8
+        for i in range(n_cal):
+            s.submit(_mk_req(i, mix[int(rng.rand() < 0.2)]))
+        t0 = time.perf_counter()
+        s.run()
+        cal_wall = time.perf_counter() - t0
+        capacity = n_cal / cal_wall
+
+    sweep = []
+    breaches_total = 0
+    max_sustained = None
+    for frac in rate_fractions:
+        rate = frac * capacity
+        engine.reset()
+        registry = StreamRegistry(window_s=window_s)
+        monitor = SLOMonitor(
+            [SLO.ttft_p95(ttft_target)], registry, min_count=8
+        )
+        arrivals = generate_arrivals(
+            LoadSpec(rate=rate, classes=mix),
+            vocab_size=cfg.vocab_size,
+            duration_s=duration_s,
+            seed=int(frac * 100),
+        )
+        server = Server(engine, stream=registry, slo=monitor)
+        with obs.span("slo_point", rate=round(rate, 1)):
+            t0 = time.perf_counter()
+            # drain=False: past saturation the queue never drains — the
+            # honest measurement is what completed inside the window.
+            server.run_timed(arrivals, duration=duration_s, drain=False)
+            wall = time.perf_counter() - t0
+        stats = server.stats()
+        sk = registry.total_sketch("request_ttft")
+        p95 = sk.quantile(0.95) if sk is not None and sk.count else None
+        rep = monitor.report()["targets"]["ttft_p95"]
+        breach_frac = rep["time_in_breach_s"] / max(wall, 1e-9)
+        gen = stats["generated_tokens"]
+        sustained = (
+            p95 is not None
+            and p95 <= ttft_target
+            and breach_frac <= 0.2
+        )
+        offered = len(arrivals) / duration_s
+        if sustained:
+            max_sustained = max(max_sustained or 0.0, offered)
+        breaches_total += rep["breaches"]
+        sweep.append(
+            {
+                "rate_fraction": frac,
+                "offered_req_per_s": round(offered, 2),
+                "completed_req_per_s": round(
+                    stats["requests_completed"] / wall, 2
+                ),
+                "ttft_p95_s": round(p95, 6) if p95 is not None else None,
+                "tokens_per_sec": round(gen / wall, 1),
+                "breach_fraction": round(breach_frac, 4),
+                "breaches": rep["breaches"],
+                "truncated": stats["truncated"],
+                "sustained": sustained,
+            }
+        )
+    return {
+        "max_sustained_req_per_s": (
+            round(max_sustained, 2) if max_sustained is not None else None
+        ),
+        "ttft_target_s": round(ttft_target, 6),
+        "slo_breaches": breaches_total,
+        "decode_attention": engine.decode_attention_mode,
+        "slots": slots,
+        "calibration": {
+            "unloaded_ttft_s": round(unloaded_ttft, 6),
+            "ttft_multiple": ttft_multiple,
+            "closed_loop_capacity_req_per_s": round(capacity, 2),
+            "mean_new_tokens": round(mean_new, 2),
+        },
+        "rate_sweep": sweep,
+        "geometry": {
+            "num_layers": cfg.num_layers,
+            "d_model": cfg.d_model,
+            "slots": slots,
+            "max_len": max_len,
+            "prefill_len": prefill_len,
+            "duration_s": duration_s,
+            "window_s": window_s,
+            "process": "poisson",
+        },
+    }
+
+
 def bench_allreduce(payload_mb: int = 64, iters: int = 10):
     """The BASELINE "allreduce GB/s" metric.
 
@@ -1033,12 +1227,23 @@ _LINE_KEYS = {
         "seq_len", "attention", "final_loss", "error",
     ),
     "gpt2_moe": (
-        "tokens_per_sec", "ms_per_step", "batch", "seq_len", "dispatch",
+        "tokens_per_sec", "ms_per_step", "batch", "seq_len",
         "final_loss", "error",
     ),
     "gpt2_serve": (
         "decode_tokens_per_sec", "decode_attention", "latency_p50_s",
-        "latency_p95_s", "slots", "requests", "error",
+        "latency_p95_s", "slots", "error",
+    ),
+    # The SLO sweep's line is the headline triple only — the sustained
+    # rate, the target that defines it, and the breach count proving the
+    # ladder actually crossed saturation; the curve, calibration,
+    # geometry and engine mode are detail-file-only (the ≤1.2k budget
+    # holds with margin; gpt2_moe's dispatch label and gpt2_serve's
+    # request count moved detail-only to pay for it — every full dict
+    # still lands in BENCH_DETAIL.json verbatim).
+    "gpt2_slo": (
+        "max_sustained_req_per_s", "ttft_target_s", "slo_breaches",
+        "error",
     ),
     "allreduce": ("gbps", "modeled", "devices", "error"),
 }
@@ -1170,6 +1375,7 @@ def main():
         ("resnet50", bench_resnet),
         ("gpt2_moe", bench_moe),
         ("gpt2_serve", bench_gpt2_serve),
+        ("gpt2_slo", bench_gpt2_slo),
     ]
 
     def _watchdog():

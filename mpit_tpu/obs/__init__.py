@@ -40,6 +40,21 @@ ISSUE 3 grows the recorder distributed, plus an automated verdict pair:
   on phase-time regressions beyond ``--tolerance-pct``); ``bench.py``
   writes one per workload into ``BENCH_DETAIL.json``.
 
+ISSUE 6 adds the STREAMING layer for sustained serving runs, where the
+Recorder's retained-event model breaks down (``max_events`` exhausts
+and percentiles silently cover a truncated prefix — which
+``summary()``/the exporters now surface via ``dropped_events``):
+
+- :mod:`~mpit_tpu.obs.stream` — bounded-memory telemetry: a mergeable
+  log-bucketed :class:`HistogramSketch` (~1% relative quantile error,
+  O(buckets) memory), rolling-window histograms/rates/gauges behind a
+  :class:`StreamRegistry` the serve path feeds per request/tick;
+- :mod:`~mpit_tpu.obs.slo` — declarative :class:`SLO` targets (p95
+  TTFT ≤ X, shed-rate ≤ Z) evaluated over those windows by an
+  :class:`SLOMonitor`: ``slo_breach``/``slo_recovered`` instants in
+  the trace, breaches fed to the Sentinel, time-in-breach and
+  time-to-detect in the roll-up.
+
 Instrumented call sites: ``train.loop.hardened_loop`` (prefetch-wait /
 step / host-fence / eval / checkpoint / divergence-restore phases),
 ``comm.collectives`` (per-op modeled wire bytes — recorded at *trace*
@@ -53,7 +68,7 @@ fast path costs a module-global check and the package can be imported
 from anywhere in the stack without cycles.
 """
 
-from mpit_tpu.obs import aggregate, baseline
+from mpit_tpu.obs import aggregate, baseline, slo, stream
 from mpit_tpu.obs.core import (
     Recorder,
     counter,
@@ -76,10 +91,16 @@ from mpit_tpu.obs.export import (
     traffic_matrix,
 )
 from mpit_tpu.obs.sentinel import Sentinel
+from mpit_tpu.obs.slo import SLO, SLOMonitor
+from mpit_tpu.obs.stream import HistogramSketch, StreamRegistry
 
 __all__ = [
+    "HistogramSketch",
     "Recorder",
+    "SLO",
+    "SLOMonitor",
     "Sentinel",
+    "StreamRegistry",
     "aggregate",
     "baseline",
     "counter",
@@ -93,9 +114,11 @@ __all__ = [
     "get_recorder",
     "instant",
     "local_recorder",
+    "slo",
     "snapshot_trace_events",
     "span",
     "span_at",
+    "stream",
     "summary",
     "traffic_matrix",
 ]

@@ -111,6 +111,22 @@ def _main_diff(argv) -> int:
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(json.dumps({"error": str(e)}))
         return 2
+    # A snapshot whose recorder hit max_events reports percentiles over
+    # a truncated prefix — gating on it would pass/fail on a clipped
+    # buffer. Unusable input, same exit as a malformed file (ISSUE 6).
+    truncated = {
+        label: snap["dropped_events"]
+        for label, snap in (("baseline", base), ("current", cur))
+        if snap.get("dropped_events")
+    }
+    if truncated:
+        print(json.dumps({
+            "error": "snapshot(s) from a truncated event buffer — "
+            "percentiles cover a clipped prefix; raise Recorder "
+            "max_events and re-record",
+            "dropped_events": truncated,
+        }))
+        return 2
     verdict = baseline.diff(base, cur, tolerance_pct=args.tolerance_pct)
     print(json.dumps(verdict, indent=1))
     return 0 if verdict["ok"] else 1
@@ -138,6 +154,7 @@ def main(argv=None) -> int:
     )
     args = ap.parse_args(argv)
 
+    dropped = 0
     with open(args.trace) as f:
         head = f.read(1)
         f.seek(0)
@@ -147,13 +164,23 @@ def main(argv=None) -> int:
             # One JSON document => Chrome trace; a JSONL stream's first
             # char is also "{", so fall back to line records on failure.
             try:
-                durs, counters = _spans_from_chrome(json.load(f))
+                doc = json.load(f)
+                durs, counters = _spans_from_chrome(doc)
+                dropped = int(doc.get("dropped_events", 0))
             except json.JSONDecodeError:
                 f.seek(0)
                 durs, counters = _spans_from_jsonl(f)
         else:
             durs, counters = _spans_from_jsonl(f)
 
+    if dropped:
+        # export_chrome_trace marked this file as truncated: the phase
+        # table below covers only the events that fit in the buffer.
+        print(
+            f"obs: WARNING: trace is truncated — the recorder dropped "
+            f"{dropped} events; percentiles cover a clipped prefix",
+            file=sys.stderr,
+        )
     if not durs:
         print(json.dumps({"error": "no span events found", "file": args.trace}))
         return 2

@@ -55,6 +55,15 @@ def snapshot(
         },
         "counters": dict(summary.get("counters", {})),
     }
+    if summary.get("instants"):
+        # Zero-duration markers (anomaly / slo_breach / slo_recovered)
+        # by count: a load workload's snapshot must record that its SLO
+        # tripped, not just its phase times (ISSUE 6).
+        out["instants"] = dict(summary["instants"])
+    if summary.get("dropped_events"):
+        # The snapshot's percentiles describe a TRUNCATED buffer — carry
+        # the fact so `obs diff` can refuse to gate on it (exit 2).
+        out["dropped_events"] = int(summary["dropped_events"])
     if meta:
         out["meta"] = dict(meta)
     return out

@@ -199,7 +199,15 @@ class Recorder:
         snap = self.snapshot()
         by_name: dict[str, list[float]] = {}
         labels: dict[str, dict[str, set]] = {}
+        instants: dict[str, int] = {}
         for kind, name, _t0, dur, _tid, attrs in snap["events"][since:]:
+            if kind == "i":
+                # Instants (anomaly, slo_breach, slo_recovered, ...) are
+                # zero-duration, so the phase table can't carry them —
+                # roll their counts up separately: a baseline snapshot
+                # must show that a load run TRIPPED its SLO, not just
+                # how long its decode ticks took (ISSUE 6).
+                instants[name] = instants.get(name, 0) + 1
             if kind == "X":
                 by_name.setdefault(name, []).append(dur)
                 # String-valued span attrs are mode LABELS (e.g. the
@@ -233,8 +241,13 @@ class Recorder:
             counters[name] = counters.get(name, 0.0) + v
         out = {"phases": phases, "collectives": collectives,
                "counters": counters}
-        if snap["dropped"]:
-            out["dropped_events"] = snap["dropped"]
+        if instants:
+            out["instants"] = dict(sorted(instants.items()))
+        # ALWAYS present (ISSUE 6 satellite): a consumer deciding
+        # whether the percentiles above describe the whole run must not
+        # have to know that absence means zero — a truncated buffer
+        # reports the spans that fit and silently represents the rest.
+        out["dropped_events"] = snap["dropped"]
         return out
 
 

@@ -11,6 +11,7 @@ checkpoint and recovery — which XPlane cannot attribute.
 from __future__ import annotations
 
 import json
+import sys
 from pathlib import Path
 from typing import Any
 
@@ -41,14 +42,17 @@ def chrome_trace_events(
     """
     rec = _require(recorder)
     if pid is None:
-        pid = 0
-        try:  # process_index when jax is up; obs itself never needs jax
-            import jax
-
-            pid = jax.process_index()
-        except Exception:
-            pass
+        pid = _default_pid()
     return snapshot_trace_events(rec.snapshot(), pid=pid)
+
+
+def _default_pid() -> int:
+    try:  # process_index when jax is up; obs itself never needs jax
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
 
 
 def snapshot_trace_events(
@@ -111,10 +115,27 @@ def export_chrome_trace(
     path (load at ``ui.perfetto.dev`` or ``chrome://tracing``)."""
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
+    # ONE snapshot feeds both the events and the dropped count — two
+    # would copy the buffer twice and could mark a file truncated by
+    # events recorded after its traceEvents were taken.
+    snap = _require(recorder).snapshot()
     doc = {
-        "traceEvents": chrome_trace_events(recorder),
+        "traceEvents": snapshot_trace_events(snap, pid=_default_pid()),
         "displayTimeUnit": "ms",
     }
+    dropped = snap["dropped"]
+    if dropped:
+        # A clipped buffer exports the spans that fit and silently
+        # represents the rest — mark the artifact AND warn, so neither
+        # a human in Perfetto nor `python -m mpit_tpu.obs` on this file
+        # reads percentiles off a truncated recording unknowingly
+        # (ISSUE 6 satellite).
+        doc["dropped_events"] = dropped
+        print(
+            f"obs: WARNING: recorder dropped {dropped} events "
+            f"(max_events hit) — {path} is a truncated trace",
+            file=sys.stderr,
+        )
     tmp = path.with_suffix(path.suffix + ".tmp")
     with open(tmp, "w") as f:
         json.dump(doc, f)

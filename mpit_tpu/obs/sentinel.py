@@ -137,6 +137,15 @@ class Sentinel:
         # Structured instant: lands in the trace next to the guilty span.
         _obs.instant("anomaly", **record)
 
+    def note(self, kind: str, metric: str, step: int, **extra) -> None:
+        """Record an EXTERNALLY detected anomaly into this sentinel's
+        report (counted, capped, and emitted as an ``anomaly`` instant
+        like the built-in detections). The SLO monitor (``obs.slo``)
+        feeds breaches through here so ``Sentinel.report()`` — the
+        run's one anomaly verdict — carries them next to spike /
+        sustained-degradation findings; ``clean`` goes false."""
+        self._emit(kind, metric, step, **extra)
+
     def observe(self, metric: str, step: int, value: float) -> None:
         """Feed one observation of ``metric`` (seconds) at ``step``.
         Ignored when a ``phases`` tuple is configured and doesn't name

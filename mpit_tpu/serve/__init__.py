@@ -28,6 +28,12 @@ per-slot KV cache and the request loop is continuous batching.
   retirement (EOS / max tokens / cache full), with full ``obs``
   integration (prefill/decode spans, per-request queue-wait/TTFT/
   latency intervals, slot-occupancy gauge).
+- :mod:`~mpit_tpu.serve.loadgen` — open-loop load generation (ISSUE
+  6): seeded Poisson / bursty arrival traces with mixed prompt/output-
+  length classes and tenant IDs, driven by ``Server.run_timed`` on the
+  arrival clock; paired with ``obs.stream`` rolling-window telemetry
+  and ``obs.slo`` SLO monitoring, this is the "heavy traffic" harness
+  the ``gpt2_slo`` bench sweep measures.
 - :mod:`~mpit_tpu.serve.weights` — dense-checkpoint ingestion: a
   ``train.convert --save-dense`` ``.npz`` from ANY training tier serves
   directly (leaf contract pinned in ``tests/test_convert.py``).
@@ -38,7 +44,14 @@ random-init), serve a synthetic request stream, print the obs summary.
 
 from mpit_tpu.serve.engine import Engine, sample_tokens
 from mpit_tpu.serve.kvcache import KVCache, alloc_cache, cache_specs
-from mpit_tpu.serve.scheduler import Completed, Request, Server
+from mpit_tpu.serve.loadgen import (
+    Arrival,
+    LoadSpec,
+    RequestClass,
+    generate_arrivals,
+    parse_load_spec,
+)
+from mpit_tpu.serve.scheduler import Completed, Request, Server, warm_engine
 from mpit_tpu.serve.weights import (
     expected_param_shapes,
     infer_config,
@@ -46,15 +59,21 @@ from mpit_tpu.serve.weights import (
 )
 
 __all__ = [
+    "Arrival",
     "Completed",
     "Engine",
     "KVCache",
+    "LoadSpec",
     "Request",
+    "RequestClass",
     "Server",
     "alloc_cache",
     "cache_specs",
     "expected_param_shapes",
+    "generate_arrivals",
     "infer_config",
     "load_gpt2_params",
+    "parse_load_spec",
     "sample_tokens",
+    "warm_engine",
 ]

@@ -2,10 +2,28 @@
 
 Loads a trained dense checkpoint (``--ckpt state.npz``, the
 ``train.convert --save-dense`` format) or random-inits a model
-(``--model tiny|small``), serves a synthetic request stream through the
+(``--model tiny|small``), serves a request stream through the
 continuous-batching engine, and prints one JSON result: the serving
 stats (tokens/s, TTFT and latency percentiles, occupancy) plus the obs
 phase summary. ``--mesh model=2`` selects the tensor-parallel engine.
+
+Two drive modes (ISSUE 6):
+
+- default — the closed-loop synthetic stream: ``--requests N`` all
+  submitted up front, run to drain;
+- ``--loadgen "rate=8,process=bursty,tenants=4"`` — the OPEN-loop
+  production harness: a seeded ``serve.loadgen`` arrival trace driven
+  by its own clock through ``Server.run_timed`` for ``--duration``
+  seconds, with a live windowed stats line on stderr every
+  ``--stats-interval`` seconds (rolling p50/p95 TTFT and latency,
+  req/s, tokens/s, occupancy, queue depth) fed from the
+  ``obs.stream`` registry — not from the Recorder's bounded buffer.
+
+``--slo-ttft-p95 / --slo-latency-p95 / --slo-shed-rate`` declare SLO
+targets; an ``obs.slo.SLOMonitor`` evaluates them over the rolling
+windows each tick, breaches land in the trace / the sentinel, and the
+final JSON carries the monitor's report (time in breach, time to
+detect). ``--max-queue`` bounds intake (excess arrivals shed).
 
 Config follows the ``asyncsgd.config`` pattern: one dataclass, argparse
 generated from its fields.
@@ -51,6 +69,21 @@ class ServeConfig:
     sentinel: bool = False  # decode/prefill tick anomaly sentinel
     trace: str = ""  # write a Chrome trace of the run here
     seed: int = 0
+    # Open-loop load harness (ISSUE 6). loadgen = "" keeps the
+    # closed-loop synthetic stream; otherwise a serve.loadgen spec
+    # ("rate=8,process=poisson|bursty,on_fraction=0.25,tenants=4,
+    # prompt_min=..,prompt_max=..,new_min=..,new_max=..").
+    loadgen: str = ""
+    duration: float = 10.0  # loadgen admission window, seconds
+    drain: bool = True  # keep ticking past the window until drained
+    max_queue: int = 0  # shed arrivals beyond this queue depth (0 = inf)
+    window_s: float = 5.0  # rolling-window span for live stats / SLOs
+    stats_interval: float = 2.0  # live stats line cadence (0 = silent)
+    # SLO targets (0 = not declared). Evaluated over the rolling
+    # windows; breaches emit slo_breach instants + sentinel notes.
+    slo_ttft_p95: float = 0.0
+    slo_latency_p95: float = 0.0
+    slo_shed_rate: float = 0.0
 
     def mesh_shape(self) -> dict[str, int] | None:
         from mpit_tpu.asyncsgd.config import parse_mesh
@@ -115,10 +148,62 @@ def synthetic_requests(cfg: ServeConfig, vocab_size: int):
         )
 
 
+def _slo_targets(cfg: ServeConfig):
+    from mpit_tpu.obs.slo import SLO
+
+    targets = []
+    if cfg.slo_ttft_p95 > 0:
+        targets.append(SLO.ttft_p95(cfg.slo_ttft_p95))
+    if cfg.slo_latency_p95 > 0:
+        targets.append(SLO.latency_p95(cfg.slo_latency_p95))
+    if cfg.slo_shed_rate > 0:
+        targets.append(SLO.shed_rate(cfg.slo_shed_rate))
+    return targets
+
+
+def _live_line(registry, monitor, server, now: float) -> str:
+    """One windowed stats line — everything on it comes from the
+    rolling windows (O(buckets)), never from the Recorder's buffer."""
+    ws = registry.window_stats()
+    h, r, g = ws["histograms"], ws["rates"], ws["gauges"]
+
+    def ms(name, k):
+        v = h.get(name, {}).get(k)
+        return f"{v * 1000:.0f}" if v is not None else "-"
+
+    line = (
+        f"[t={now:6.1f}s] "
+        f"ttft p50/p95={ms('request_ttft', 'p50')}/"
+        f"{ms('request_ttft', 'p95')}ms "
+        f"lat p95={ms('request_latency', 'p95')}ms "
+        f"req/s={r.get('serve_arrivals', {}).get('rate_per_s', 0.0):.1f} "
+        f"tok/s={r.get('serve_tokens', {}).get('rate_per_s', 0.0):.0f} "
+        f"occ={g.get('slot_occupancy', 0.0):.2f} "
+        f"q={g.get('queue_depth', 0.0):.0f} "
+        f"done={len(server.completed)} shed={len(server.shed)}"
+    )
+    if monitor is not None:
+        breached = [
+            name
+            for name, t in monitor.report()["targets"].items()
+            if t["in_breach"]
+        ]
+        if breached:
+            line += " SLO-BREACH:" + ",".join(breached)
+    return line
+
+
 def main(argv: list[str] | None = None) -> dict:
     cfg = from_argv(ServeConfig, argv, prog="python -m mpit_tpu.serve")
     from mpit_tpu import obs
-    from mpit_tpu.serve import Server
+    from mpit_tpu.obs.slo import SLOMonitor
+    from mpit_tpu.obs.stream import StreamRegistry
+    from mpit_tpu.serve import (
+        Server,
+        generate_arrivals,
+        parse_load_spec,
+        warm_engine,
+    )
 
     rec = obs.enable(obs.Recorder())
     sentinel = (
@@ -127,12 +212,83 @@ def main(argv: list[str] | None = None) -> dict:
         else None
     )
     engine, mcfg = _build_engine(cfg)
-    server = Server(engine, sentinel=sentinel)
-    for req in synthetic_requests(cfg, mcfg.vocab_size):
-        server.submit(req)
-    t0 = time.perf_counter()
-    server.run()
-    wall = time.perf_counter() - t0
+    registry = StreamRegistry(window_s=cfg.window_s)
+    targets = _slo_targets(cfg)
+    monitor = (
+        SLOMonitor(targets, registry, sentinel=sentinel) if targets else None
+    )
+    spec = parse_load_spec(cfg.loadgen) if cfg.loadgen else None
+    if spec is not None:
+        # Fail BEFORE the timed window, not on whichever arrival first
+        # draws a long prompt mid-trace: submit() treats an oversized
+        # request as a caller bug, and for the CLI the caller is the
+        # spec/geometry pair given right here.
+        for klass in spec.classes:
+            if klass.prompt_len[1] > cfg.prefill_len:
+                raise SystemExit(
+                    f"--loadgen class {klass.name!r}: prompt_max "
+                    f"{klass.prompt_len[1]} > --prefill-len "
+                    f"{cfg.prefill_len}"
+                )
+            need = klass.prompt_len[1] + klass.max_new_tokens[1]
+            if need > cfg.max_len:
+                raise SystemExit(
+                    f"--loadgen class {klass.name!r}: prompt_max + "
+                    f"new_max = {need} > --max-len {cfg.max_len}"
+                )
+        # Warm the engine's two compiles OUTSIDE the timed window — an
+        # open-loop harness that pays multi-second XLA compiles inside
+        # its first arrivals' TTFT measures the compiler, not the
+        # server.
+        warm_engine(engine)
+        arrivals = generate_arrivals(
+            spec,
+            vocab_size=mcfg.vocab_size,
+            duration_s=cfg.duration,
+            seed=cfg.seed,
+        )
+        server = Server(
+            engine,
+            sentinel=sentinel,
+            stream=registry,
+            slo=monitor,
+            max_queue=cfg.max_queue or None,
+        )
+        last_line = [0.0]
+
+        def on_tick(srv, now):
+            if cfg.stats_interval <= 0:
+                return
+            if now - last_line[0] < cfg.stats_interval:
+                return
+            last_line[0] = now
+            print(
+                _live_line(registry, monitor, srv, now),
+                file=sys.stderr,
+                flush=True,
+            )
+
+        t0 = time.perf_counter()
+        server.run_timed(
+            arrivals,
+            duration=cfg.duration,
+            drain=cfg.drain,
+            on_tick=on_tick,
+        )
+        wall = time.perf_counter() - t0
+    else:
+        server = Server(
+            engine,
+            sentinel=sentinel,
+            stream=registry,
+            slo=monitor,
+            max_queue=cfg.max_queue or None,
+        )
+        for req in synthetic_requests(cfg, mcfg.vocab_size):
+            server.submit(req)
+        t0 = time.perf_counter()
+        server.run()
+        wall = time.perf_counter() - t0
 
     summ = rec.summary()
     stats = server.stats()
@@ -162,6 +318,18 @@ def main(argv: list[str] | None = None) -> dict:
             for name, p in summ["phases"].items()
         },
     }
+    if spec is not None:
+        out["load"] = {
+            "rate": spec.rate,
+            "process": spec.process,
+            "tenants": spec.tenants,
+            "duration_s": cfg.duration,
+            "arrivals": len(arrivals),
+            "shed": len(server.shed),
+        }
+        out["window_stats"] = registry.window_stats()
+    if monitor is not None:
+        out["slo"] = monitor.report()
     if sentinel is not None:
         out["sentinel"] = sentinel.report()
     if cfg.trace:

@@ -1,0 +1,272 @@
+"""Open-loop load generation: arrivals that look like production.
+
+The bench/CLI streams so far are CLOSED-loop — every request submitted
+up front, so the queue can only drain and "load" is whatever the engine
+happens to sustain. Production traffic is OPEN-loop: arrivals come on
+their own clock whether or not the server keeps up, and that difference
+is the whole point of an SLO sweep — past saturation the queue grows
+without bound and TTFT explodes, which a closed-loop stream can never
+show (ISSUE 6 tentpole; ROADMAP item 4). This module generates that
+traffic:
+
+- :class:`LoadSpec` — the declarative process: mean ``rate`` req/s,
+  ``process="poisson"`` (memoryless) or ``"bursty"`` (on/off modulated
+  Poisson: silent off-phases, on-phases at ``rate / on_fraction`` so
+  the LONG-RUN mean stays ``rate`` — peaks are ``1/on_fraction``× the
+  mean), a mixture of :class:`RequestClass` shapes (interactive vs
+  batch prompt/output lengths), and round-robin-free random ``tenants``;
+- :func:`generate_arrivals` — materializes one seeded arrival trace:
+  ``[Arrival(t, Request)]`` sorted by time, fully determined by
+  ``(spec, seed, vocab_size)`` — same seed, same trace, both processes
+  (pinned in ``tests/test_serve.py``), so a sweep point is replayable
+  and two engines can be A/B'd on literally identical traffic;
+- :func:`parse_load_spec` — ``"rate=8,process=bursty,tenants=4"`` →
+  :class:`LoadSpec`, the serve CLI's ``--loadgen`` syntax (shared with
+  bench so the sweep and the CLI drive the same generator).
+
+``Server.run_timed`` (``serve.scheduler``) consumes the trace: requests
+are submitted when their arrival clock comes due, never before.
+Host-side pure numpy — no jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from mpit_tpu.serve.scheduler import Request
+
+__all__ = [
+    "Arrival",
+    "LoadSpec",
+    "RequestClass",
+    "generate_arrivals",
+    "parse_load_spec",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestClass:
+    """One shape class in the traffic mix: uniform prompt/output-length
+    ranges (inclusive) drawn per request, weighted against the other
+    classes. Names label the request (``Request.rid`` carries the class
+    via the trace; the class itself rides ``Arrival.klass``)."""
+
+    name: str
+    weight: float = 1.0
+    prompt_len: tuple[int, int] = (4, 16)
+    max_new_tokens: tuple[int, int] = (8, 32)
+
+    def __post_init__(self):
+        for field, (lo, hi) in (
+            ("prompt_len", self.prompt_len),
+            ("max_new_tokens", self.max_new_tokens),
+        ):
+            if not 1 <= lo <= hi:
+                raise ValueError(
+                    f"class {self.name!r}: {field} range must satisfy "
+                    f"1 <= lo <= hi, got ({lo}, {hi})"
+                )
+        if self.weight <= 0:
+            raise ValueError(
+                f"class {self.name!r}: weight must be > 0, got {self.weight}"
+            )
+
+
+# The default production-ish mix: mostly short interactive turns, a
+# tail of long batch-style requests (mixed lengths are what make
+# admission/scheduling policy interesting — ROADMAP item 4).
+DEFAULT_MIX = (
+    RequestClass("interactive", weight=0.8, prompt_len=(2, 12),
+                 max_new_tokens=(4, 16)),
+    RequestClass("batch", weight=0.2, prompt_len=(12, 28),
+                 max_new_tokens=(16, 48)),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadSpec:
+    """Declarative open-loop arrival process.
+
+    ``rate`` is the long-run MEAN arrival rate (req/s) for both
+    processes; ``bursty`` concentrates it into on-phases of mean
+    ``mean_on_s`` seconds at ``rate / on_fraction`` req/s separated by
+    silent off-phases (phase durations exponential, time-fraction on =
+    ``on_fraction``). ``tenants`` > 0 stamps each request with a
+    uniform-random ``t<k>`` tenant id.
+    """
+
+    rate: float
+    process: str = "poisson"
+    on_fraction: float = 0.25
+    mean_on_s: float = 1.0
+    tenants: int = 0
+    classes: tuple[RequestClass, ...] = DEFAULT_MIX
+    temperature: float = 0.0
+    top_k: int = 0
+
+    def __post_init__(self):
+        if self.rate <= 0:
+            raise ValueError(f"rate must be > 0 req/s, got {self.rate}")
+        if self.process not in ("poisson", "bursty"):
+            raise ValueError(
+                f"process must be poisson|bursty, got {self.process!r}"
+            )
+        if not 0.0 < self.on_fraction <= 1.0:
+            raise ValueError(
+                f"on_fraction must be in (0, 1], got {self.on_fraction}"
+            )
+        if self.mean_on_s <= 0:
+            raise ValueError(f"mean_on_s must be > 0, got {self.mean_on_s}")
+        if not self.classes:
+            raise ValueError("need at least one RequestClass")
+        if self.tenants < 0:
+            raise ValueError(f"tenants must be >= 0, got {self.tenants}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """One request and the moment it arrives (seconds from stream
+    start — ``Server.run_timed`` maps it onto its own wall clock)."""
+
+    t: float
+    request: Request
+    klass: str = ""
+
+
+def _arrival_times(spec: LoadSpec, rng, duration_s: float,
+                   max_requests: int) -> list[float]:
+    """Times in [0, duration_s), at most max_requests of them."""
+    times: list[float] = []
+    if spec.process == "poisson":
+        t = 0.0
+        while len(times) < max_requests:
+            t += float(rng.exponential(1.0 / spec.rate))
+            if t >= duration_s:
+                break
+            times.append(t)
+        return times
+    # Bursty: walk exponential on/off phases; arrivals only in ON
+    # phases, at the elevated rate. mean_off chosen so the expected
+    # time-fraction on is on_fraction (=> long-run mean rate == rate).
+    rate_on = spec.rate / spec.on_fraction
+    mean_off = spec.mean_on_s * (1.0 - spec.on_fraction) / spec.on_fraction
+    t = 0.0
+    while t < duration_s and len(times) < max_requests:
+        on_end = t + float(rng.exponential(spec.mean_on_s))
+        while len(times) < max_requests:
+            t += float(rng.exponential(1.0 / rate_on))
+            if t >= on_end or t >= duration_s:
+                break
+            times.append(t)
+        t = max(t, on_end)
+        if mean_off > 0.0:
+            t += float(rng.exponential(mean_off))
+    return times
+
+
+def generate_arrivals(
+    spec: LoadSpec,
+    *,
+    vocab_size: int,
+    duration_s: float,
+    max_requests: int = 100_000,
+    seed: int = 0,
+    eos_id: int | None = None,
+) -> list[Arrival]:
+    """Materialize one arrival trace: sorted :class:`Arrival` records,
+    fully determined by ``(spec, vocab_size, duration_s, max_requests,
+    seed)``. ``max_requests`` bounds memory for high-rate × long-
+    duration combinations (the trace is built up front so a sweep point
+    is replayable; ~100 bytes/request)."""
+    if duration_s <= 0:
+        raise ValueError(f"duration_s must be > 0, got {duration_s}")
+    rng = np.random.RandomState(seed)
+    times = _arrival_times(spec, rng, duration_s, max_requests)
+    weights = np.asarray([c.weight for c in spec.classes], np.float64)
+    weights /= weights.sum()
+    out: list[Arrival] = []
+    for i, t in enumerate(times):
+        klass = spec.classes[int(rng.choice(len(spec.classes), p=weights))]
+        plen = int(rng.randint(klass.prompt_len[0],
+                               klass.prompt_len[1] + 1))
+        new = int(rng.randint(klass.max_new_tokens[0],
+                              klass.max_new_tokens[1] + 1))
+        tenant = (
+            f"t{int(rng.randint(spec.tenants))}" if spec.tenants else ""
+        )
+        out.append(
+            Arrival(
+                t=t,
+                klass=klass.name,
+                request=Request(
+                    rid=i,
+                    prompt=rng.randint(0, vocab_size, size=plen).tolist(),
+                    max_new_tokens=new,
+                    temperature=spec.temperature,
+                    top_k=spec.top_k,
+                    eos_id=eos_id,
+                    tenant=tenant,
+                ),
+            )
+        )
+    return out
+
+
+# Keys parse_load_spec accepts, with their coercions. Prompt/output
+# overrides collapse the class mix to ONE uniform class — the CLI knob
+# for "just give me N-token prompts"; the full mixture stays
+# programmatic (bench, tests).
+_SPEC_KEYS = {
+    "rate": float,
+    "process": str,
+    "on_fraction": float,
+    "mean_on_s": float,
+    "tenants": int,
+}
+_RANGE_KEYS = ("prompt_min", "prompt_max", "new_min", "new_max")
+
+
+def parse_load_spec(text: str) -> LoadSpec:
+    """``"rate=8,process=bursty,on_fraction=0.25,tenants=4"`` →
+    :class:`LoadSpec` (the serve CLI's ``--loadgen`` value).
+
+    Optional ``prompt_min/prompt_max/new_min/new_max`` replace the
+    default interactive/batch mixture with a single uniform class over
+    those ranges.
+    """
+    kw: dict = {}
+    ranges: dict[str, int] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"--loadgen parts are key=value, got {part!r}"
+            )
+        key, val = part.split("=", 1)
+        key = key.strip()
+        if key in _SPEC_KEYS:
+            kw[key] = _SPEC_KEYS[key](val)
+        elif key in _RANGE_KEYS:
+            ranges[key] = int(val)
+        else:
+            raise ValueError(
+                f"unknown --loadgen key {key!r} (valid: "
+                f"{', '.join((*_SPEC_KEYS, *_RANGE_KEYS))})"
+            )
+    if "rate" not in kw:
+        raise ValueError("--loadgen needs rate=<req/s>")
+    if ranges:
+        kw["classes"] = (
+            RequestClass(
+                "uniform",
+                prompt_len=(ranges.get("prompt_min", 4),
+                            ranges.get("prompt_max", 16)),
+                max_new_tokens=(ranges.get("new_min", 8),
+                                ranges.get("new_max", 32)),
+            ),
+        )
+    return LoadSpec(**kw)

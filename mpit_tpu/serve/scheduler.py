@@ -24,11 +24,36 @@ Observability (``mpit_tpu.obs``) is first-class, not bolted on:
 An optional :class:`mpit_tpu.obs.Sentinel` (``phases=("decode",
 "prefill")``) watches the tick stream for spikes/sustained degradation
 — the serving analogue of the training loop's step-wall sentinel.
+
+ISSUE 6 grows the loop production-shaped:
+
+- **Streaming telemetry**: ``Server(stream=StreamRegistry())`` feeds
+  per-request TTFT/latency/queue-wait into rolling-window histogram
+  sketches and per-tick token/arrival rates + queue/occupancy gauges —
+  live percentiles over the last N seconds at O(buckets) memory, so a
+  sustained run's telemetry never depends on the Recorder's bounded
+  event buffer (``obs.stream``).
+- **SLO monitoring**: ``Server(slo=SLOMonitor(...))`` evaluates
+  declared targets (p95 TTFT ≤ X, shed-rate ≤ Z, ...) against those
+  windows once per tick; breach transitions emit ``slo_breach`` /
+  ``slo_recovered`` instants and feed the sentinel (``obs.slo``).
+- **Timed drive**: :meth:`Server.run_timed` admits an OPEN-loop
+  arrival trace (``serve.loadgen``) by its arrival clock — requests
+  are submitted when due, never up front, so offered load is a
+  property of the trace, not of how fast the server drains.
+- **Request lifelines**: per-request spans carry ``rid`` (and
+  ``tenant`` when set) and batch spans carry ``rids``, so one
+  request's queue-wait → prefill → decode path is filterable in the
+  Perfetto export.
+- **Bounded intake**: ``Server(max_queue=N)`` sheds arrivals beyond N
+  queued (counted in ``serve_shed`` / ``Server.shed`` — the shed-rate
+  SLO's numerator); unbounded by default.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 import time
 from collections import deque
 from typing import Any
@@ -38,13 +63,27 @@ import numpy as np
 from mpit_tpu import obs
 from mpit_tpu.ops.decode_attention import num_kv_blocks
 
-__all__ = ["Request", "Completed", "Server"]
+__all__ = ["Request", "Completed", "Server", "warm_engine"]
+
+
+def warm_engine(engine) -> None:
+    """Pay the engine's two XLA compiles (prefill + decode) with one
+    throwaway request, then reset the cache — call BEFORE any timed
+    window so an open-loop harness's first arrivals measure the server,
+    not the compiler. Prompt content is irrelevant: the padded
+    prefill/decode buffers fix the traced shapes."""
+    warm = Server(engine)
+    warm.submit(Request(rid="warm", prompt=[1, 2, 3], max_new_tokens=2))
+    warm.run()
+    engine.reset()
 
 
 @dataclasses.dataclass
 class Request:
     """One generation request. ``temperature <= 0`` = greedy;
-    ``top_k = 0`` = full vocab; ``eos_id = None`` = never stop early."""
+    ``top_k = 0`` = full vocab; ``eos_id = None`` = never stop early;
+    ``tenant`` labels the requester (multi-tenant load traces) and is
+    stamped on the request's spans when non-empty."""
 
     rid: Any
     prompt: list[int]
@@ -52,6 +91,7 @@ class Request:
     temperature: float = 0.0
     top_k: int = 0
     eos_id: int | None = None
+    tenant: str = ""
 
 
 @dataclasses.dataclass
@@ -66,6 +106,7 @@ class Completed:
     first_token_t: float
     finish_t: float
     truncated: bool = False  # retired by cache-full, not EOS/max-tokens
+    tenant: str = ""
 
     @property
     def ttft_s(self) -> float:
@@ -89,12 +130,34 @@ class Server:
 
     Host-side only: slot bookkeeping, the request queue, retirement and
     telemetry. ``submit()`` enqueues; ``run()`` drives admit/decode
-    ticks until the queue and all slots drain (or ``max_ticks``).
+    ticks until the queue and all slots drain (or ``max_ticks``);
+    ``run_timed()`` drives an open-loop arrival trace by its clock.
+
+    ``stream`` (a :class:`mpit_tpu.obs.stream.StreamRegistry`) receives
+    the rolling-window feed — ``request_ttft`` / ``request_latency`` /
+    ``queue_wait`` histograms, ``serve_arrivals`` / ``serve_completed``
+    / ``serve_tokens`` / ``serve_shed`` rates, ``queue_depth`` /
+    ``slot_occupancy`` gauges; ``slo`` (a
+    :class:`mpit_tpu.obs.slo.SLOMonitor` over the same registry) is
+    evaluated once per tick. ``max_queue`` bounds the host queue:
+    arrivals beyond it are SHED (recorded, not raised — open-loop
+    traffic does not stop because the server is full).
     """
 
-    def __init__(self, engine, *, sentinel=None):
+    def __init__(self, engine, *, sentinel=None, stream=None, slo=None,
+                 max_queue=None):
         self.engine = engine
         self.sentinel = sentinel
+        self.stream = stream
+        self.slo = slo
+        if slo is not None and stream is None:
+            raise ValueError(
+                "Server(slo=...) needs the stream registry the monitor "
+                "evaluates over — pass stream=slo.registry"
+            )
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
         # The attention mode + sampler actually executing — stamped on
         # every prefill/decode span so the flight recorder / sentinel can
         # attribute a serve-path regression to a kernel fallback (ISSUE 5
@@ -109,16 +172,30 @@ class Server:
         self.live: dict[int, _Live] = {}  # slot -> in-flight request
         self.free: list[int] = list(range(engine.slots))[::-1]  # pop() = slot 0 first
         self.completed: list[Completed] = []
+        self.shed: list[Request] = []
         self.tick = 0
         self.admissions = 0
         self._occupancy_sum = 0.0
+        self._truncated = False  # a run stopped with work still pending
         # Per-slot sampling-control arrays (host; refreshed on admit/retire).
         s = engine.slots
         self._temp = np.zeros((s,), np.float32)
         self._topk = np.zeros((s,), np.int32)
 
     # -- intake -------------------------------------------------------------
-    def submit(self, req: Request) -> None:
+    def _span_attrs(self, req: Request) -> dict:
+        """rid (+ tenant when set) for per-request span stamping —
+        tenant is a string, so it also rolls up as a summary label."""
+        return (
+            {"rid": req.rid, "tenant": req.tenant}
+            if req.tenant
+            else {"rid": req.rid}
+        )
+
+    def submit(self, req: Request) -> bool:
+        """Enqueue one request; returns False when ``max_queue`` shed
+        it instead (malformed requests still raise — shedding is a
+        LOAD decision, validation is a caller bug)."""
         if not req.prompt:
             raise ValueError(f"request {req.rid!r}: empty prompt")
         if req.max_new_tokens < 1:
@@ -146,7 +223,21 @@ class Server:
                 f"{k_cap}); raise Engine(sample_k_cap=...) or use "
                 f"top_k=0 (full vocab)"
             )
+        if self.stream is not None:
+            # Arrivals count BEFORE the shed decision: the shed-rate
+            # SLO is shed/arrivals, so both sides of the ratio must see
+            # every request that showed up.
+            self.stream.inc("serve_arrivals")
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            self.shed.append(req)
+            obs.counter("serve_shed")
+            obs.instant("request_shed", queue_depth=len(self.queue),
+                        **self._span_attrs(req))
+            if self.stream is not None:
+                self.stream.inc("serve_shed")
+            return False
         self.queue.append(_Live(req, time.perf_counter()))
+        return True
 
     # -- the loop -----------------------------------------------------------
     def _admit(self) -> None:
@@ -169,11 +260,20 @@ class Server:
             admit[slot] = True
             self._temp[slot] = live.req.temperature
             self._topk[slot] = live.req.top_k
-            obs.span_at("queue_wait", live.submit_t, now, rid=live.req.rid)
+            obs.span_at(
+                "queue_wait", live.submit_t, now,
+                **self._span_attrs(live.req),
+            )
+            if self.stream is not None:
+                self.stream.observe("queue_wait", now - live.submit_t)
             batch.append((slot, live))
         with obs.span(
             "prefill", admitted=len(batch), attention=self._attn_mode,
             sampler=self._sampler,
+            # The admitted rids, as a LIST (a non-string attr stays out
+            # of the summary's label roll-up but lands in the trace
+            # args) — one request's lifeline is filterable in Perfetto.
+            rids=[live.req.rid for _, live in batch],
         ):
             first = self.engine.prefill(
                 tokens, lens, admit, self._temp, self._topk
@@ -188,8 +288,13 @@ class Server:
             live.first_token_t = t_first
             live.tokens = [int(first[slot])]
             obs.span_at(
-                "request_ttft", live.submit_t, t_first, rid=live.req.rid
+                "request_ttft", live.submit_t, t_first,
+                **self._span_attrs(live.req),
             )
+            if self.stream is not None:
+                self.stream.observe(
+                    "request_ttft", t_first - live.submit_t
+                )
             self.live[slot] = live
             self._maybe_retire(slot, t_first)
 
@@ -215,8 +320,13 @@ class Server:
         self.free.append(slot)
         self._temp[slot] = 0.0
         self._topk[slot] = 0
-        obs.span_at("request_latency", live.submit_t, now, rid=req.rid)
+        obs.span_at(
+            "request_latency", live.submit_t, now, **self._span_attrs(req)
+        )
         obs.counter("serve_requests")
+        if self.stream is not None:
+            self.stream.observe("request_latency", now - live.submit_t)
+            self.stream.inc("serve_completed")
         self.completed.append(
             Completed(
                 rid=req.rid,
@@ -228,6 +338,7 @@ class Server:
                 truncated=full
                 and tok != req.eos_id
                 and len(live.tokens) < req.max_new_tokens,
+                tenant=req.tenant,
             )
         )
 
@@ -239,12 +350,15 @@ class Server:
         with obs.span(
             "decode", active=int(active.sum()), attention=self._attn_mode,
             sampler=self._sampler,
+            rids=[live.req.rid for live in self.live.values()],
         ):
             toks = self.engine.decode(active, self._temp, self._topk)
         now = time.perf_counter()
         if self.sentinel is not None:
             self.sentinel.observe_phases(self.tick, decode=now - t0)
         obs.counter("serve_tokens", float(active.sum()))
+        if self.stream is not None:
+            self.stream.inc("serve_tokens", float(active.sum()))
         if self._attn_mode == "kernel" and self.live:
             # Cache tiles the length-aware kernel skipped this tick —
             # ONE formula, num_kv_blocks, shared with the kernel's own
@@ -278,17 +392,99 @@ class Server:
             self.live[slot].tokens.append(int(toks[slot]))
             self._maybe_retire(slot, now)
 
+    def _run_tick(self) -> None:
+        """One loop iteration: admit, gauges, decode, SLO evaluation."""
+        self._admit()
+        occupancy = len(self.live) / self.engine.slots
+        self._occupancy_sum += occupancy
+        obs.gauge("slot_occupancy", occupancy)
+        if self.stream is not None:
+            self.stream.set_gauge("slot_occupancy", occupancy)
+            self.stream.set_gauge("queue_depth", float(len(self.queue)))
+        if self.live:
+            self._decode_tick()
+        if self.slo is not None:
+            self.slo.evaluate(tick=self.tick)
+        self.tick += 1
+
     def run(self, *, max_ticks: int = 1_000_000) -> list[Completed]:
         """Drive admit/decode until everything submitted has completed
-        (then return ALL completions so far, in finish order)."""
+        (then return ALL completions so far, in finish order). Hitting
+        ``max_ticks`` with work still queued/live sets the
+        ``truncated`` flag ``stats()`` reports — partial completions
+        must not read as a finished run."""
         while (self.queue or self.live) and self.tick < max_ticks:
-            self._admit()
-            occupancy = len(self.live) / self.engine.slots
-            self._occupancy_sum += occupancy
-            obs.gauge("slot_occupancy", occupancy)
-            if self.live:
-                self._decode_tick()
-            self.tick += 1
+            self._run_tick()
+        if self.queue or self.live:
+            self._truncated = True
+        if self.slo is not None:
+            self.slo.finish()
+        return self.completed
+
+    def run_timed(
+        self,
+        arrivals,
+        *,
+        duration: float | None = None,
+        drain: bool = True,
+        max_ticks: int = 1_000_000,
+        on_tick=None,
+    ) -> list[Completed]:
+        """Open-loop drive: submit each :class:`~mpit_tpu.serve.loadgen.
+        Arrival` when its clock (seconds from the call) comes due, tick
+        the engine in between, and stop admitting at ``duration``
+        seconds (``None`` = when the trace is exhausted).
+
+        ``drain=True`` keeps ticking past the admission window until
+        queued + live work finishes — every admitted request gets an
+        answer (the CLI default). ``drain=False`` stops AT the window's
+        end — the honest overload measurement: past saturation the
+        queue grows without bound and a drain would never return; what
+        completed inside the window is the result, and ``stats()``
+        reports ``truncated`` for the rest. ``on_tick(server, now_s)``
+        is called once per loop iteration (the CLI's live stats line).
+        Requests shed by ``max_queue`` are counted, not raised.
+        """
+        arrivals = sorted(arrivals, key=lambda a: a.t)
+        t0 = time.perf_counter()
+        i = 0
+        end_t = math.inf if duration is None else duration
+        while self.tick < max_ticks:
+            now = time.perf_counter() - t0
+            while i < len(arrivals) and arrivals[i].t <= min(now, end_t):
+                self.submit(arrivals[i].request)
+                i += 1
+            pending_arrivals = i < len(arrivals) and arrivals[i].t < end_t
+            if now >= end_t and not (drain and (self.queue or self.live)):
+                break
+            if not pending_arrivals and not (self.queue or self.live):
+                if now >= end_t or i >= len(arrivals):
+                    break  # trace exhausted and everything answered
+            if not (self.queue or self.live):
+                # Idle: sleep to the next arrival (or the window edge)
+                # instead of spinning the host loop dry.
+                wake = arrivals[i].t if pending_arrivals else end_t
+                delay = min(wake - now, 0.05)
+                if delay > 0:
+                    time.sleep(delay)
+                # An idle stretch still advances SLO time (a breach
+                # does not end because traffic paused).
+                if self.slo is not None:
+                    self.slo.evaluate(tick=self.tick)
+                if on_tick is not None:
+                    on_tick(self, now)
+                continue
+            self._run_tick()
+            if on_tick is not None:
+                on_tick(self, time.perf_counter() - t0)
+        if self.queue or self.live:
+            self._truncated = True
+        if self.slo is not None:
+            # One closing evaluation: work admitted/shed after the last
+            # in-loop evaluate (e.g. the final burst before a
+            # drain=False window edge) must still get a verdict.
+            self.slo.evaluate(tick=self.tick)
+            self.slo.finish()
         return self.completed
 
     # -- reporting ----------------------------------------------------------
@@ -304,7 +500,13 @@ class Server:
             "occupancy_mean": round(
                 self._occupancy_sum / max(self.tick, 1), 4
             ),
+            # A run that stopped at max_ticks / the timed window with
+            # work still queued or live is PARTIAL — indistinguishable
+            # from finished without this flag (ISSUE 6 satellite).
+            "truncated": self._truncated,
         }
+        if self.shed:
+            out["requests_shed"] = len(self.shed)
         if done:
             lat = np.asarray([c.latency_s for c in done])
             ttft = np.asarray([c.ttft_s for c in done])

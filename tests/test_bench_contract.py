@@ -160,6 +160,37 @@ def _realistic_results():
             "phases": phases,
             "obs_baseline": obs_baseline,
         },
+        "gpt2_slo": {
+            "max_sustained_req_per_s": 123.45,
+            "ttft_target_s": 0.234567,
+            "slo_breaches": 12,
+            "decode_attention": "reference",
+            "slots": 4,
+            "calibration": {
+                "unloaded_ttft_s": 0.046913,
+                "ttft_multiple": 5.0,
+                "closed_loop_capacity_req_per_s": 176.45,
+                "mean_new_tokens": 8.3,
+            },
+            "rate_sweep": [
+                {"rate_fraction": f, "offered_req_per_s": 123.45,
+                 "completed_req_per_s": 120.12, "ttft_p95_s": 1.234567,
+                 "tokens_per_sec": 1234.5, "breach_fraction": 0.1234,
+                 "breaches": 3, "truncated": True, "sustained": False}
+                for f in (0.4, 0.7, 1.0, 1.5)
+            ],
+            "geometry": {"num_layers": 2, "d_model": 128, "slots": 4,
+                         "max_len": 64, "prefill_len": 16,
+                         "duration_s": 2.5, "window_s": 1.5,
+                         "process": "poisson"},
+            "phases": phases,
+            "obs_baseline": {
+                **obs_baseline,
+                # The acceptance pin's shape: the overload point's
+                # breach instants ride the gate snapshot (ISSUE 6).
+                "instants": {"slo_breach": 12, "slo_recovered": 9},
+            },
+        },
         "allreduce": {
             "gbps": 50.88,
             "modeled": True,
@@ -234,7 +265,23 @@ class TestLineBudget:
                         "decode_sampler",
                         "reference_decode_tokens_per_sec"):
             assert off_line not in serve
-        # The obs phase breakdown is detail-file-only too (ISSUE 1), and
+        # The SLO sweep (ISSUE 6): the headline triple — max sustained
+        # req/s at p95 TTFT ≤ target, the target defining it, and the
+        # breach count proving the ladder crossed saturation — rides
+        # the line; the rate→(TTFT, tok/s, breach) curve, calibration
+        # basis, geometry and engine mode are detail-file-only. To keep
+        # the ≤1.2k budget, gpt2_moe's dispatch label and gpt2_serve's
+        # request count also moved detail-only.
+        slo = rec["detail"]["gpt2_slo"]
+        assert slo["max_sustained_req_per_s"] == 123.45
+        assert slo["ttft_target_s"] == 0.234567
+        assert slo["slo_breaches"] == 12
+        for off_line in ("rate_sweep", "calibration", "geometry",
+                         "decode_attention", "slots"):
+            assert off_line not in slo
+        assert "dispatch" not in rec["detail"]["gpt2_moe"]
+        assert "requests" not in rec["detail"]["gpt2_serve"]
+        # The obs phase breakdown is detail-only too (ISSUE 1), and
         # so are the gap ATTRIBUTION (the line carries only the pct),
         # the perf-gate snapshot, and the MoE drop trajectory (ISSUE 3).
         for wl in rec["detail"].values():
@@ -271,7 +318,47 @@ class TestLineBudget:
         # Worst case: every workload died before producing numbers.
         rec = json.loads(_line({}, truncated=[
             "allreduce", "alexnet", "gpt2", "resnet50", "gpt2_moe",
-            "gpt2_serve",
+            "gpt2_serve", "gpt2_slo",
         ], elapsed_s=0.5))
         assert rec["value"] is None
         assert rec["vs_baseline"] is None
+
+
+class TestSLOArtifact:
+    """ISSUE 6 acceptance, pinned against the committed artifact: the
+    gpt2_slo sweep's BENCH_DETAIL.json entry must carry the headline
+    AND the proof the overload point actually tripped — ``slo_breach``
+    instants in the workload's obs_baseline gate snapshot (emitted by
+    the SLOMonitor during the sweep, rolled up by Recorder.summary()).
+    """
+
+    def _entry(self):
+        from pathlib import Path
+
+        detail = json.loads(
+            (Path(bench.__file__).parent / "BENCH_DETAIL.json").read_text()
+        )
+        assert "gpt2_slo" in detail["workloads"], (
+            "BENCH_DETAIL.json has no gpt2_slo entry — re-run "
+            "`python bench.py` (or the standalone gpt2_slo run)"
+        )
+        return detail["workloads"]["gpt2_slo"]
+
+    def test_headline_and_curve_present(self):
+        e = self._entry()
+        assert e["max_sustained_req_per_s"] is not None
+        assert e["ttft_target_s"] > 0
+        sweep = e["rate_sweep"]
+        assert len(sweep) >= 3
+        # The ladder straddles saturation by construction: the top
+        # point is overloaded (breached and/or truncated mid-queue).
+        top = sweep[-1]
+        assert top["breaches"] >= 1 or top["truncated"]
+
+    def test_breach_instants_ride_the_gate_snapshot(self):
+        base = self._entry()["obs_baseline"]
+        assert base["instants"]["slo_breach"] >= 1
+        # ... and the snapshot's buffer was NOT clipped (the key is only
+        # written when events dropped — a truncated recording would make
+        # `obs diff` refuse to gate on this snapshot, exit 2).
+        assert base.get("dropped_events", 0) == 0

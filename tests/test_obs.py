@@ -1065,6 +1065,102 @@ class TestBaselineGate:
         assert out.returncode == 2
 
 
+class TestTruncationSurfacing:
+    """ISSUE 6 satellite: a clipped event buffer must be LOUD.
+
+    The Recorder keeps at most ``max_events`` events; a sustained load
+    run that overflows it would otherwise report percentiles over a
+    truncated prefix with nothing to distinguish them from the real
+    thing — so ``summary()`` always carries ``dropped_events``, the
+    exporters mark/warn, and ``obs diff`` refuses to gate (exit 2).
+    """
+
+    def _run_cli(self, *argv):
+        import subprocess
+        import sys
+
+        return subprocess.run(
+            [sys.executable, "-m", "mpit_tpu.obs", *argv],
+            capture_output=True, text=True, timeout=120,
+        )
+
+    def test_summary_always_reports_dropped_events(self):
+        """Zero must be stated, not inferred from absence: the consumer
+        deciding whether percentiles cover the whole run reads one key
+        either way."""
+        rec = obs.enable(obs.Recorder())
+        with obs.span("x"):
+            pass
+        assert rec.summary()["dropped_events"] == 0
+
+    def test_summary_rolls_up_instant_counts(self):
+        rec = obs.enable(obs.Recorder())
+        obs.instant("slo_breach", slo="ttft_p95")
+        obs.instant("slo_breach", slo="ttft_p95")
+        obs.instant("slo_recovered", slo="ttft_p95")
+        s = rec.summary()
+        assert s["instants"] == {"slo_breach": 2, "slo_recovered": 1}
+
+    def test_chrome_export_marks_and_warns(self, tmp_path, capsys):
+        rec = obs.enable(obs.Recorder(max_events=4))
+        for _ in range(10):
+            with obs.span("step"):
+                pass
+        path = obs.export_chrome_trace(tmp_path / "t.json", rec)
+        assert "truncated" in capsys.readouterr().err
+        assert json.load(open(path))["dropped_events"] == 6
+        # A clean recording carries neither the mark nor the warning.
+        rec2 = obs.enable(obs.Recorder())
+        with obs.span("step"):
+            pass
+        path2 = obs.export_chrome_trace(tmp_path / "t2.json", rec2)
+        assert "dropped_events" not in json.load(open(path2))
+        assert capsys.readouterr().err == ""
+
+    def test_trace_summary_cli_warns_on_truncated_trace(self, tmp_path):
+        rec = obs.enable(obs.Recorder(max_events=4))
+        for _ in range(10):
+            with obs.span("step"):
+                time.sleep(0.001)
+        path = obs.export_chrome_trace(tmp_path / "t.json", rec)
+        out = self._run_cli(str(path))
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "truncated" in out.stderr
+        assert json.loads(out.stdout)["phases"]["step"]["count"] == 4
+
+    def test_snapshot_carries_truncation_and_instants(self):
+        snap = obs.baseline.snapshot({
+            "phases": {"step": {"count": 4, "total_s": 0.4, "p50_s": 0.1,
+                                "p95_s": 0.12}},
+            "counters": {},
+            "instants": {"slo_breach": 3},
+            "dropped_events": 7,
+        })
+        assert snap["dropped_events"] == 7
+        assert snap["instants"] == {"slo_breach": 3}
+
+    def test_diff_refuses_truncated_snapshot(self, tmp_path):
+        """A perf gate must not pass/fail on percentiles from a clipped
+        buffer — unusable input, same exit as a malformed file."""
+        clean = {
+            "phases": {"step": {"count": 4, "total_s": 0.4, "p50_s": 0.1,
+                                "p95_s": 0.12}},
+            "counters": {},
+        }
+        base = obs.baseline.save(tmp_path / "base.json", clean)
+        cur = obs.baseline.save(
+            tmp_path / "cur.json", {**clean, "dropped_events": 7}
+        )
+        out = self._run_cli("diff", str(base), str(cur))
+        assert out.returncode == 2
+        doc = json.loads(out.stdout)
+        assert "truncated" in doc["error"]
+        assert doc["dropped_events"] == {"current": 7}
+        # Both clean: the same pair gates normally.
+        out = self._run_cli("diff", str(base), str(base))
+        assert out.returncode == 0
+
+
 class TestHardenedLoopTelemetry:
     """The ISSUE 1 acceptance criterion, on the fake 8-device CPU mesh."""
 

@@ -24,7 +24,7 @@ import pytest
 import mpit_tpu
 from mpit_tpu import obs
 from mpit_tpu.models import GPT2, GPT2Config
-from mpit_tpu.serve import Engine, Request, Server
+from mpit_tpu.serve import Engine, Request, Server, warm_engine
 
 CFG = GPT2Config.tiny(
     vocab_size=64, max_seq_len=64, num_layers=2, num_heads=2, d_model=32,
@@ -604,3 +604,395 @@ class TestServeCLI:
         assert out["requests_completed"] == 3
         assert out["model"]["layers"] == CFG.num_layers
         assert out["model"]["vocab"] == CFG.vocab_size
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6: open-loop load harness + streaming SLO telemetry on the serve path.
+# ---------------------------------------------------------------------------
+
+from mpit_tpu.obs.slo import SLO, SLOMonitor  # noqa: E402
+from mpit_tpu.obs.stream import StreamRegistry  # noqa: E402
+from mpit_tpu.serve import (  # noqa: E402
+    LoadSpec,
+    RequestClass,
+    generate_arrivals,
+    parse_load_spec,
+)
+
+# A mix bounded to the tiny test engines' geometry (prefill_len 8,
+# max_len 40): prompt + new <= 14.
+TEST_MIX = (
+    RequestClass("interactive", weight=0.7, prompt_len=(2, 6),
+                 max_new_tokens=(2, 4)),
+    RequestClass("batch", weight=0.3, prompt_len=(4, 8),
+                 max_new_tokens=(3, 6)),
+)
+
+
+def _trace_key(arrivals):
+    return [
+        (a.t, a.klass, a.request.prompt, a.request.max_new_tokens,
+         a.request.tenant)
+        for a in arrivals
+    ]
+
+
+class TestLoadGen:
+    @pytest.mark.parametrize("process", ["poisson", "bursty"])
+    def test_same_seed_identical_trace(self, process):
+        """Determinism (ISSUE 6 satellite): a sweep point must be
+        replayable and two engines A/B-able on identical traffic."""
+        spec = LoadSpec(rate=25.0, process=process, tenants=3,
+                        classes=TEST_MIX)
+        a = generate_arrivals(spec, vocab_size=64, duration_s=4.0, seed=11)
+        b = generate_arrivals(spec, vocab_size=64, duration_s=4.0, seed=11)
+        assert len(a) > 0
+        assert _trace_key(a) == _trace_key(b)
+
+    @pytest.mark.parametrize("process", ["poisson", "bursty"])
+    def test_different_seed_different_trace(self, process):
+        spec = LoadSpec(rate=25.0, process=process, classes=TEST_MIX)
+        a = generate_arrivals(spec, vocab_size=64, duration_s=4.0, seed=1)
+        b = generate_arrivals(spec, vocab_size=64, duration_s=4.0, seed=2)
+        assert _trace_key(a) != _trace_key(b)
+
+    @pytest.mark.parametrize("process", ["poisson", "bursty"])
+    def test_trace_shape_and_bounds(self, process):
+        spec = LoadSpec(rate=40.0, process=process, tenants=2,
+                        classes=TEST_MIX)
+        arr = generate_arrivals(spec, vocab_size=64, duration_s=5.0, seed=0)
+        times = [a.t for a in arrivals] if (arrivals := arr) else []
+        assert times == sorted(times)
+        assert all(0.0 <= t < 5.0 for t in times)
+        for a in arr:
+            klass = {c.name: c for c in TEST_MIX}[a.klass]
+            plo, phi = klass.prompt_len
+            assert plo <= len(a.request.prompt) <= phi
+            nlo, nhi = klass.max_new_tokens
+            assert nlo <= a.request.max_new_tokens <= nhi
+            assert a.request.tenant in ("t0", "t1")
+            assert all(0 <= tok < 64 for tok in a.request.prompt)
+        # rids are unique (they key the per-request lifeline).
+        rids = [a.request.rid for a in arr]
+        assert len(set(rids)) == len(rids)
+
+    def test_long_run_mean_rate_both_processes(self):
+        """The bursty process concentrates arrivals but its LONG-RUN
+        mean must stay ``rate`` — that is what makes sweep points
+        comparable across processes."""
+        for process in ("poisson", "bursty"):
+            spec = LoadSpec(rate=50.0, process=process, classes=TEST_MIX)
+            # 600 s ≈ 150 on/off cycles: enough to average the bursty
+            # process's per-cycle variance (std ~8% here; a 60 s run is
+            # ~15 cycles and routinely lands 2σ+ out).
+            n = len(generate_arrivals(
+                spec, vocab_size=64, duration_s=600.0,
+                max_requests=10**6, seed=3,
+            ))
+            assert 0.8 * 30_000 < n < 1.2 * 30_000, (process, n)
+
+    def test_bursty_is_actually_bursty(self):
+        """On/off modulation: with on_fraction 0.25 the busiest second
+        should see well above the mean rate, and some seconds silence."""
+        spec = LoadSpec(rate=20.0, process="bursty", on_fraction=0.25,
+                        mean_on_s=0.5, classes=TEST_MIX)
+        arr = generate_arrivals(spec, vocab_size=64, duration_s=30.0,
+                                seed=5)
+        per_second = np.bincount([int(a.t) for a in arr], minlength=30)
+        assert per_second.max() >= 2.0 * spec.rate
+        assert (per_second == 0).any()
+
+    def test_max_requests_caps_trace(self):
+        spec = LoadSpec(rate=1000.0, classes=TEST_MIX)
+        arr = generate_arrivals(spec, vocab_size=64, duration_s=10.0,
+                                max_requests=50, seed=0)
+        assert len(arr) == 50
+
+    def test_tenants_zero_means_unlabeled(self):
+        arr = generate_arrivals(
+            LoadSpec(rate=30.0, classes=TEST_MIX), vocab_size=64,
+            duration_s=2.0, seed=0,
+        )
+        assert all(a.request.tenant == "" for a in arr)
+
+    def test_parse_load_spec(self):
+        spec = parse_load_spec(
+            "rate=8, process=bursty, on_fraction=0.5, tenants=4"
+        )
+        assert spec.rate == 8.0 and spec.process == "bursty"
+        assert spec.on_fraction == 0.5 and spec.tenants == 4
+        assert spec.classes == loadgen_default_mix()
+
+    def test_parse_load_spec_range_override(self):
+        spec = parse_load_spec("rate=2,prompt_min=3,prompt_max=5,new_min=2,"
+                               "new_max=4")
+        (klass,) = spec.classes
+        assert klass.prompt_len == (3, 5)
+        assert klass.max_new_tokens == (2, 4)
+
+    def test_parse_load_spec_errors(self):
+        with pytest.raises(ValueError, match="rate="):
+            parse_load_spec("process=poisson")
+        with pytest.raises(ValueError, match="key=value"):
+            parse_load_spec("rate=1,bogus")
+        with pytest.raises(ValueError, match="unknown"):
+            parse_load_spec("rate=1,nope=2")
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="rate"):
+            LoadSpec(rate=0.0)
+        with pytest.raises(ValueError, match="process"):
+            LoadSpec(rate=1.0, process="uniform")
+        with pytest.raises(ValueError, match="on_fraction"):
+            LoadSpec(rate=1.0, on_fraction=0.0)
+        with pytest.raises(ValueError, match="prompt_len"):
+            RequestClass("x", prompt_len=(0, 4))
+        with pytest.raises(ValueError, match="weight"):
+            RequestClass("x", weight=0.0)
+        with pytest.raises(ValueError, match="duration_s"):
+            generate_arrivals(LoadSpec(rate=1.0), vocab_size=64,
+                              duration_s=0.0)
+
+
+def loadgen_default_mix():
+    from mpit_tpu.serve.loadgen import DEFAULT_MIX
+
+    return DEFAULT_MIX
+
+
+def _warmed_engine(params, *, slots=2):
+    engine = Engine(CFG, params, slots=slots, max_len=40, prefill_len=8)
+    warm_engine(engine)
+    return engine
+
+
+class TestRunTimed:
+    def test_open_loop_greedy_bitmatch(self, model_and_params):
+        """The PR 4 invariant survives the open-loop drive: every
+        request admitted by its arrival clock still bit-matches the
+        isolated no-cache forward."""
+        model, params = model_and_params
+        engine = _warmed_engine(params)
+        arr = generate_arrivals(
+            LoadSpec(rate=60.0, classes=TEST_MIX, tenants=2),
+            vocab_size=CFG.vocab_size, duration_s=0.5, seed=7,
+        )
+        assert len(arr) >= 8
+        server = Server(engine)
+        done = server.run_timed(arr, drain=True)
+        assert len(done) == len(arr)
+        assert server.stats()["truncated"] is False
+        by_rid = {a.request.rid: a.request for a in arr}
+        for c in done:
+            req = by_rid[c.rid]
+            assert c.tokens == ref_greedy(
+                model, params, req.prompt, len(c.tokens)
+            )
+            assert c.tenant == req.tenant
+
+    def test_drain_false_stops_at_window_and_flags_truncated(
+        self, model_and_params
+    ):
+        _, params = model_and_params
+        engine = _warmed_engine(params)
+        # Offered load far beyond a 2-slot engine: the queue cannot
+        # drain inside the window.
+        arr = generate_arrivals(
+            LoadSpec(rate=300.0, classes=TEST_MIX),
+            vocab_size=CFG.vocab_size, duration_s=0.6, seed=0,
+        )
+        server = Server(engine)
+        done = server.run_timed(arr, duration=0.6, drain=False)
+        assert len(done) < len(arr)
+        assert server.stats()["truncated"] is True
+
+    def test_max_queue_sheds_not_raises(self, model_and_params):
+        _, params = model_and_params
+        rec = obs.Recorder()
+        with obs.local_recorder(rec):
+            engine = Engine(CFG, params, slots=2, max_len=40,
+                            prefill_len=8)
+            reg = StreamRegistry()
+            server = Server(engine, stream=reg, max_queue=2)
+            oks = [
+                server.submit(Request(rid=i, prompt=[1 + i],
+                                      max_new_tokens=2))
+                for i in range(5)
+            ]
+        assert oks == [True, True, False, False, False]
+        assert [r.rid for r in server.shed] == [2, 3, 4]
+        assert len(server.queue) == 2
+        # Both sides of the shed-rate ratio saw every arrival.
+        assert reg.counter_total("serve_arrivals") == 5.0
+        assert reg.counter_total("serve_shed") == 3.0
+        summ = rec.summary()
+        assert summ["counters"]["serve_shed"] == 3
+        assert summ["instants"]["request_shed"] == 3
+        # stats() reports the shed count alongside completions.
+        assert server.stats()["requests_shed"] == 3
+
+    def test_request_lifeline_attrs_in_trace(self, model_and_params):
+        """rid (and tenant) ride every per-request span, and batch
+        prefill/decode spans carry the admitted/active rids — one
+        request's lifeline is filterable in the Perfetto export."""
+        _, params = model_and_params
+        rec = obs.Recorder()
+        with obs.local_recorder(rec):
+            engine = Engine(CFG, params, slots=2, max_len=40,
+                            prefill_len=8)
+            server = Server(engine)
+            server.submit(Request(rid=42, prompt=[5, 9], max_new_tokens=3,
+                                  tenant="t7"))
+            server.submit(Request(rid=43, prompt=[7], max_new_tokens=2))
+            server.run()
+        events = obs.snapshot_trace_events(rec.snapshot())
+        spans = {}
+        for e in events:
+            if e.get("ph") == "X":
+                spans.setdefault(e["name"], []).append(e["args"])
+        for name in ("queue_wait", "request_ttft", "request_latency"):
+            args42 = [a for a in spans[name] if a.get("rid") == 42]
+            assert args42 and args42[0]["tenant"] == "t7"
+            args43 = [a for a in spans[name] if a.get("rid") == 43]
+            assert args43 and "tenant" not in args43[0]
+        assert any(42 in a.get("rids", []) for a in spans["prefill"])
+        assert any(42 in a.get("rids", []) for a in spans["decode"])
+
+    def test_run_max_ticks_sets_truncated(self, model_and_params):
+        """ISSUE 6 satellite: a run() that hit the tick cap must not be
+        indistinguishable from a finished run."""
+        _, params = model_and_params
+        engine = Engine(CFG, params, slots=2, max_len=40, prefill_len=8)
+        server = Server(engine)
+        for i in range(4):
+            server.submit(Request(rid=i, prompt=[1 + i], max_new_tokens=8))
+        server.run(max_ticks=2)
+        assert server.stats()["truncated"] is True
+        # Finishing the drain clears nothing: truncation is a property
+        # of the run history, but a fresh full run never sets it.
+        engine.reset()
+        server2 = Server(engine)
+        server2.submit(Request(rid=0, prompt=[3], max_new_tokens=2))
+        server2.run()
+        assert server2.stats()["truncated"] is False
+
+    def test_slo_requires_stream(self, model_and_params):
+        _, params = model_and_params
+        engine = Engine(CFG, params, slots=2, max_len=40, prefill_len=8)
+        reg = StreamRegistry()
+        mon = SLOMonitor([SLO.ttft_p95(1.0)], reg)
+        with pytest.raises(ValueError, match="stream"):
+            Server(engine, slo=mon)
+        Server(engine, stream=reg, slo=mon)  # correct pairing is fine
+        with pytest.raises(ValueError, match="max_queue"):
+            Server(engine, max_queue=0)
+
+
+class TestStreamingServeTelemetry:
+    def test_windowed_p95_agrees_with_exact_closed_loop(
+        self, model_and_params
+    ):
+        """ISSUE 6 acceptance: on a closed-loop run, the streaming
+        sketch's end-of-run percentiles agree with exact numpy
+        percentiles over the same completions within the sketch's
+        pinned bound (2% relative, against either order statistic
+        adjacent to the quantile rank)."""
+        _, params = model_and_params
+        engine = _warmed_engine(params)
+        reg = StreamRegistry()
+        server = Server(engine, stream=reg)
+        rng = np.random.RandomState(0)
+        for i in range(24):
+            server.submit(Request(
+                rid=i,
+                prompt=rng.randint(0, CFG.vocab_size,
+                                   size=rng.randint(1, 8)).tolist(),
+                max_new_tokens=int(rng.randint(2, 6)),
+            ))
+        done = server.run()
+        assert len(done) == 24
+        for metric, exact_vals in (
+            ("request_ttft", [c.ttft_s for c in done]),
+            ("request_latency", [c.latency_s for c in done]),
+        ):
+            sk = reg.total_sketch(metric)
+            assert sk.count == 24
+            vals = np.sort(np.asarray(exact_vals))
+            for q in (0.5, 0.95):
+                got = sk.quantile(q)
+                rank = q * (len(vals) - 1)
+                lo = vals[int(np.floor(rank))] * (1 - 0.02)
+                hi = vals[int(np.ceil(rank))] * (1 + 0.02)
+                assert lo <= got <= hi, (metric, q, got, vals)
+
+    def test_overload_trips_slo_breach_everywhere(self, model_and_params):
+        """ISSUE 6 acceptance: an injected overload run trips
+        ``slo_breach``, visible in Sentinel.report() AND the Chrome
+        trace, with time-in-breach accumulated in the monitor."""
+        _, params = model_and_params
+        rec = obs.Recorder()
+        with obs.local_recorder(rec):
+            engine = _warmed_engine(params)
+            reg = StreamRegistry(window_s=2.0)
+            sent = obs.Sentinel(phases=("decode", "prefill"))
+            # A physically impossible TTFT target: any measured window
+            # breaches as soon as min_count requests complete.
+            mon = SLOMonitor([SLO.ttft_p95(1e-5)], reg, min_count=4,
+                             sentinel=sent)
+            server = Server(engine, stream=reg, slo=mon, sentinel=sent)
+            arr = generate_arrivals(
+                LoadSpec(rate=80.0, classes=TEST_MIX),
+                vocab_size=CFG.vocab_size, duration_s=0.8, seed=1,
+            )
+            server.run_timed(arr, duration=0.8, drain=False)
+        rep = mon.report()
+        t = rep["targets"]["ttft_p95"]
+        assert rep["ok"] is False and t["breaches"] >= 1
+        assert t["time_in_breach_s"] > 0
+        srep = sent.report()
+        assert srep["clean"] is False
+        assert srep["anomaly_counts"]["slo_breach"] >= 1
+        events = obs.snapshot_trace_events(rec.snapshot())
+        breach = [e for e in events
+                  if e.get("ph") == "i" and e["name"] == "slo_breach"]
+        assert breach and breach[0]["args"]["slo"] == "ttft_p95"
+        # And the recorder summary rolls the instant count up.
+        assert rec.summary()["instants"]["slo_breach"] >= 1
+
+
+class TestServeCLILoadgen:
+    def test_cli_loadgen_end_to_end(self, capsys):
+        from mpit_tpu.serve.__main__ import main
+
+        out = main(
+            [
+                "--slots", "2", "--max-len", "96", "--prefill-len", "32",
+                "--loadgen", "rate=25,process=poisson,tenants=2",
+                "--duration", "1.0", "--stats-interval", "0.2",
+                "--drain", "false", "--max-queue", "8",
+                "--slo-ttft-p95", "0.00001", "--slo-shed-rate", "0.5",
+            ]
+        )
+        assert out["load"]["process"] == "poisson"
+        assert out["load"]["arrivals"] > 0
+        assert out["window_stats"]["rates"]["serve_arrivals"][
+            "window_total"
+        ] > 0
+        slo = out["slo"]["targets"]
+        assert set(slo) == {"ttft_p95", "shed_rate"}
+        assert slo["ttft_p95"]["breaches"] >= 1  # impossible target
+        # The live stats line went to stderr.
+        err = capsys.readouterr().err
+        assert "ttft p50/p95=" in err
+
+    def test_cli_loadgen_geometry_mismatch_fails_fast(self):
+        from mpit_tpu.serve.__main__ import main
+
+        with pytest.raises(SystemExit, match="prompt_max"):
+            main(
+                [
+                    "--prefill-len", "8", "--max-len", "96",
+                    "--loadgen", "rate=5",  # default mix: prompts to 28
+                    "--duration", "0.2",
+                ]
+            )
