@@ -734,6 +734,201 @@ def _serve_stream(
     return engine, stats, wall, decode_tokens, decode_s, gen
 
 
+def _paged_capacity_block(page_size: int = 16):
+    """Paged-vs-dense capacity at a FIXED HBM budget (ISSUE 7's pinned
+    win). Budget = the dense engine's cache rows (``slots × max_len``);
+    the paged pool gets exactly that many rows (``budget/page_size``
+    pages) and a wide slot batch (batch width is host arrays + FLOPs,
+    not HBM). The stream: page-aligned shared prefix + short tail, short
+    generations — tokens actually held per request ≈ 28 of the dense
+    path's 128-row reservation, so concurrency stops scaling with
+    ``slots × max_len`` and starts scaling with tokens held (and shared
+    prefix pages are stored once). Reports measured peak concurrency +
+    decode tokens/s for both engines at identical traffic.
+    """
+    import numpy as np
+
+    from mpit_tpu import obs
+    from mpit_tpu.models import GPT2, GPT2Config
+    from mpit_tpu.serve import Engine, Request, Server, warm_engine
+
+    dense_slots, max_len = 4, 128
+    budget_rows = dense_slots * max_len  # the HBM the dense cache burns
+    num_pages = budget_rows // page_size
+    paged_slots = dense_slots * 8
+    prefix_len, tail, max_new = page_size, 4, 8
+    n_requests = paged_slots + dense_slots * 4
+
+    cfg = GPT2Config.tiny(max_seq_len=max_len)
+    params = jax.jit(GPT2(cfg).init)(
+        jax.random.key(2), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    rng = np.random.RandomState(3)
+    prefix = rng.randint(0, cfg.vocab_size, size=prefix_len).tolist()
+    reqs = [
+        Request(
+            rid=i,
+            prompt=prefix
+            + rng.randint(0, cfg.vocab_size, size=tail).tolist(),
+            max_new_tokens=max_new,
+        )
+        for i in range(n_requests)
+    ]
+
+    def _measure(engine):
+        warm_engine(engine)
+        server = Server(engine)
+        t0 = time.perf_counter()
+        # Prime the prefix index before the wave: sharing requires a
+        # REGISTERED prefix (registration happens when a prefill
+        # completes — same-tick co-admissions are cold by design), so
+        # the first request runs two ticks alone. The dense engine gets
+        # the identical schedule, so the A/B traffic stays equal.
+        server.submit(reqs[0])
+        server.run(max_ticks=2)
+        for r in reqs[1:]:
+            server.submit(r)
+        server.run()
+        wall = time.perf_counter() - t0
+        st = server.stats()
+        dtok = st["generated_tokens"] - st["requests_completed"]
+        return st, dtok / wall if wall else None
+
+    with obs.span("paged_capacity"):
+        d_stats, d_tps = _measure(
+            Engine(cfg, params, slots=dense_slots, max_len=max_len,
+                   prefill_len=prefix_len + tail)
+        )
+        p_stats, p_tps = _measure(
+            Engine(cfg, params, slots=paged_slots, max_len=max_len,
+                   prefill_len=prefix_len + tail,
+                   kv_pages=num_pages, kv_page_size=page_size)
+        )
+    return {
+        "hbm_budget_rows": budget_rows,
+        "page_size": page_size,
+        "request_shape": {"prefix_len": prefix_len, "tail": tail,
+                          "max_new": max_new, "requests": n_requests},
+        "dense": {
+            "slots": dense_slots,
+            "max_concurrent": d_stats["concurrency_peak"],
+            "decode_tokens_per_sec": round(d_tps, 1) if d_tps else None,
+        },
+        "paged": {
+            "slots": paged_slots,
+            "pages": num_pages,
+            "max_concurrent": p_stats["concurrency_peak"],
+            "decode_tokens_per_sec": round(p_tps, 1) if p_tps else None,
+            "pool_occupancy_peak": p_stats["kv_pool_occupancy_peak"],
+            "prefix_hit_rate": p_stats["prefix_hit_rate"],
+            "pages_shared_peak": p_stats["prefix_pages_shared_peak"],
+            "cow_copies": p_stats["kv_cow_copies"],
+        },
+        "concurrency_ratio": round(
+            p_stats["concurrency_peak"]
+            / max(d_stats["concurrency_peak"], 1),
+            2,
+        ),
+    }
+
+
+def _chunked_prefill_block(prefill_chunk: int = 32):
+    """Chunked-prefill TTFT under the mixed-length open-loop harness
+    (ISSUE 7): the SAME seeded arrival trace (80% short interactive
+    prompts, 20% long batch prompts) driven through the paged engine
+    with whole-prompt prefills vs ``prefill_chunk``-token slices.
+
+    The long admits are what head-of-line-blocks INTERACTIVE TTFT;
+    chunking bounds any tick's prefill work, so the interactive class's
+    p95 TTFT is the headline improvement. The long requests' own TTFT
+    rises (their prompt now lands over several ticks with decode
+    interleaved — that is the trade chunking makes, and why overall
+    p95, which sits inside the 20% long class, can move the other way);
+    both classes' percentiles are recorded so the trade is explicit.
+    """
+    import numpy as np
+
+    from mpit_tpu import obs
+    from mpit_tpu.models import GPT2, GPT2Config
+    from mpit_tpu.serve import (
+        Engine,
+        LoadSpec,
+        RequestClass,
+        Server,
+        generate_arrivals,
+        warm_engine,
+    )
+
+    prefill_len, max_len = 256, 320
+    cfg = GPT2Config.tiny(max_seq_len=max_len)
+    params = jax.jit(GPT2(cfg).init)(
+        jax.random.key(4), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    mix = (
+        RequestClass("interactive", weight=0.8, prompt_len=(2, 10),
+                     max_new_tokens=(2, 6)),
+        RequestClass("batch", weight=0.2,
+                     prompt_len=(prefill_len - 64, prefill_len),
+                     max_new_tokens=(2, 6)),
+    )
+    duration = 2.5
+
+    def _measure(chunk):
+        engine = Engine(
+            cfg, params, slots=4, max_len=max_len,
+            prefill_len=prefill_len, kv_pages=96, kv_page_size=16,
+            prefill_chunk=chunk,
+        )
+        warm_engine(engine)
+        # Rate calibrated roughly to CPU tiny-model tick cost; the A/B
+        # shares ONE trace, so the absolute rate only sets pressure.
+        arrivals = generate_arrivals(
+            LoadSpec(rate=14.0, classes=mix),
+            vocab_size=cfg.vocab_size, duration_s=duration, seed=11,
+        )
+        server = Server(engine)
+        server.run_timed(arrivals, duration=duration, drain=True)
+        by_class = {a.request.rid: a.klass for a in arrivals}
+        ttft = np.asarray([c.ttft_s for c in server.completed])
+        inter = np.asarray(
+            [c.ttft_s for c in server.completed
+             if by_class[c.rid] == "interactive"]
+        )
+        batch_t = np.asarray(
+            [c.ttft_s for c in server.completed
+             if by_class[c.rid] == "batch"]
+        )
+        pct = lambda a, q: (
+            round(float(np.percentile(a, q)), 6) if a.size else None
+        )
+        return {
+            "completed": len(server.completed),
+            "ttft_p50_s": pct(ttft, 50),
+            "ttft_p95_s": pct(ttft, 95),
+            "interactive_ttft_p50_s": pct(inter, 50),
+            "interactive_ttft_p95_s": pct(inter, 95),
+            "batch_ttft_p95_s": pct(batch_t, 95),
+        }
+
+    with obs.span("chunked_prefill_ab"):
+        unchunked = _measure(None)
+        chunked = _measure(prefill_chunk)
+    u, c = (unchunked["interactive_ttft_p95_s"],
+            chunked["interactive_ttft_p95_s"])
+    imp = (u - c) / u if u and c is not None else None
+    return {
+        "geometry": {"slots": 4, "prefill_len": prefill_len,
+                     "prefill_chunk": prefill_chunk, "kv_pages": 96,
+                     "kv_page_size": 16, "duration_s": duration,
+                     "rate": 14.0},
+        "unchunked": unchunked,
+        "chunked": chunked,
+        "interactive_ttft_p95_improvement_pct": round(100 * imp, 1)
+        if imp is not None
+        else None,
+    }
+
+
 def bench_gpt2_serve(
     slots: int = 8,
     prompt_len: int = 64,
@@ -759,6 +954,13 @@ def bench_gpt2_serve(
       the entry) — with the length-aware kernel the curve should
       flatten relative to O(max_len) dense decode; ``kv_blocks_*``
       record how many cache tiles a tick actually visits.
+
+    ISSUE 7 pins the paged-cache win on top: ``paged_capacity``
+    (detail) measures max concurrent requests at a FIXED HBM budget,
+    paged pool vs dense cache, with prefix sharing live; the headline
+    ``max_concurrent_at_hbm`` + ``prefix_hit_rate`` + ``kv_page_size``
+    ride the record line. ``chunked_prefill`` (detail) A/Bs p95 TTFT on
+    one mixed-length open-loop trace, whole-prompt vs chunked admits.
 
     The record line carries the resolved ``decode_attention`` mode
     (what actually executed — "kernel" falls back to "reference" math
@@ -901,6 +1103,17 @@ def bench_gpt2_serve(
         },
         "points": sweep,
     }
+    # ISSUE 7: the paged-cache capacity win + chunked-prefill TTFT A/B
+    # (full blocks detail-only; the line gets the headline triple).
+    out["paged_capacity"] = _paged_capacity_block()
+    out["chunked_prefill"] = _chunked_prefill_block()
+    out["kv_page_size"] = out["paged_capacity"]["page_size"]
+    out["prefix_hit_rate"] = out["paged_capacity"]["paged"][
+        "prefix_hit_rate"
+    ]
+    out["max_concurrent_at_hbm"] = out["paged_capacity"]["paged"][
+        "max_concurrent"
+    ]
     return out
 
 
@@ -1212,10 +1425,13 @@ def _phase_breakdown(s: dict) -> dict:
 
 # Per-workload keys that ride ON THE LINE; everything else detail-file-only.
 _LINE_KEYS = {
+    # app_path_images_per_sec is byte-for-byte the record's headline
+    # ``value`` — dropped from the per-workload detail (with gpt2's
+    # derivable vs_r1_app_path) to pay for ISSUE 7's serve triple
+    # inside the ≤1.2k budget; BENCH_DETAIL.json keeps the full dict.
     "alexnet": (
-        "images_per_sec", "app_path_images_per_sec",
-        "app_path_overhead_pct", "ms_per_step", "global_batch",
-        "final_loss", "error",
+        "images_per_sec", "app_path_overhead_pct", "ms_per_step",
+        "global_batch", "final_loss", "error",
     ),
     "resnet50": (
         "images_per_sec", "ms_per_step", "global_batch", "final_loss",
@@ -1230,9 +1446,14 @@ _LINE_KEYS = {
         "tokens_per_sec", "ms_per_step", "batch", "seq_len",
         "final_loss", "error",
     ),
+    # ISSUE 7 grows the serve line by the paged-cache headline triple:
+    # max concurrent requests at the fixed HBM budget, the prefix-hit
+    # rate behind it, and the page size defining both; the capacity and
+    # chunked-prefill blocks stay detail-only.
     "gpt2_serve": (
         "decode_tokens_per_sec", "decode_attention", "latency_p50_s",
-        "latency_p95_s", "slots", "error",
+        "latency_p95_s", "slots", "kv_page_size", "prefix_hit_rate",
+        "max_concurrent_at_hbm", "error",
     ),
     # The SLO sweep's line is the headline triple only — the sustained
     # rate, the target that defines it, and the breach count proving the
@@ -1267,9 +1488,6 @@ def build_record(results: dict, pending=(), truncated=(), elapsed_s=None,
     gpt2 = detail.get("gpt2")
     if gpt2 and "tokens_per_sec" in gpt2:
         gpt2["vs_r1"] = round(gpt2["tokens_per_sec"] / r1_gpt2, 3)
-        gpt2["vs_r1_app_path"] = round(
-            gpt2["app_path_tokens_per_sec"] / r1_gpt2, 3
-        )
     alex = results.get("alexnet", {})
     value = alex.get("app_path_images_per_sec")
     rec = {

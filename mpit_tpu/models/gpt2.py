@@ -64,6 +64,71 @@ def cache_update(cache, new, lengths):
     return jax.vmap(write)(cache, new, lengths)
 
 
+def paged_cache_update(pool, new, lengths, block_table, valid=None):
+    """Write ``new`` [B, T, H, Dh] into the page pool [P, page_size, H,
+    Dh] at sequence positions ``lengths .. lengths+T-1``, indirected
+    through ``block_table`` [B, pages_per_slot] int32 (ISSUE 7).
+
+    The paged analogue of :func:`cache_update` — but a scatter, not a
+    per-slot dynamic slice: each (b, t) resolves to flat pool row
+    ``bt[b, pos//ps] * ps + pos % ps``. ``valid`` [B, T] bool masks
+    rows that must NOT land (prefill padding past the real prompt, and
+    positions below a shared-prefix write floor — shared pages are
+    immutable); masked rows scatter to an out-of-bounds index and are
+    DROPPED, so — unlike the dense path, where junk writes stayed
+    inside the slot's own row — a padded prefill can never touch a
+    page the slot does not own.
+    """
+    p, ps = pool.shape[0], pool.shape[1]
+    b, t = new.shape[0], new.shape[1]
+    pos = lengths[:, None] + jnp.arange(t)[None, :]  # [B, T]
+    page = jnp.take_along_axis(
+        block_table, jnp.clip(pos // ps, 0, block_table.shape[1] - 1),
+        axis=1,
+    )
+    flat = page * ps + pos % ps
+    # A position past the slot's virtual capacity must be DROPPED, not
+    # clipped into its last page (padding rows can reach here even
+    # before any explicit mask).
+    flat = jnp.where(pos < block_table.shape[1] * ps, flat, p * ps)
+    if valid is not None:
+        flat = jnp.where(valid, flat, p * ps)  # OOB -> dropped
+    pool_flat = pool.reshape(p * ps, *pool.shape[2:])
+    pool_flat = pool_flat.at[flat.reshape(-1)].set(
+        new.astype(pool.dtype).reshape(b * t, *new.shape[2:]),
+        mode="drop",
+    )
+    return pool_flat.reshape(pool.shape)
+
+
+def paged_gather(pool, block_table):
+    """Materialize each slot's dense cache view from the pool:
+    [P, page_size, H, Dh] gathered through [B, pages_per_slot] →
+    [B, pages_per_slot·page_size, H, Dh]. Rows past a slot's fill are
+    whatever the mapped (or stale) pages hold — garbage by design; the
+    attention mask defines validity, exactly as in the dense cache."""
+    g = pool[block_table]  # [B, n_ps, ps, H, Dh]
+    return g.reshape(g.shape[0], -1, *g.shape[3:])
+
+
+def paged_cached_attention(q, k_pool, v_pool, lengths, block_table):
+    """Reference paged attention: gather the dense per-slot view, then
+    the exact :func:`cached_attention` math. The gathered view has the
+    same length and contents (at visible positions) as the dense
+    engine's buffer, and masked keys contribute exact zeros — so greedy
+    decode through the paged path bit-matches the dense reference
+    engine. The serving kernel path
+    (:func:`mpit_tpu.ops.decode_attention.flash_paged_decode_attention`)
+    never materializes this view — it DMAs only visited tiles, resolved
+    per-tile through the block table."""
+    return cached_attention(
+        q,
+        paged_gather(k_pool, block_table),
+        paged_gather(v_pool, block_table),
+        lengths,
+    )
+
+
 def cached_attention(q, k, v, lengths):
     """Causal attention of new queries against a padded KV cache.
 
@@ -121,6 +186,11 @@ class GPT2Config:
     # same ``(q, k_cache, v_cache, lengths)`` signature. The training
     # path (``attention_fn``) is untouched by this field.
     cache_attention_fn: Any = None
+    # Attention on the PAGED cache path (ISSUE 7): ``(q, k_pool,
+    # v_pool, lengths, block_table)``. None = the gather-dense
+    # reference :func:`paged_cached_attention`; the paged engine plugs
+    # in :func:`mpit_tpu.ops.decode_attention.flash_paged_decode_attention`.
+    paged_attention_fn: Any = None
 
     @property
     def ln_out_dtype(self):
@@ -159,8 +229,14 @@ class Block(nn.Module):
         [B, S_max, H, Dh] and lengths [B] — the new tokens' K/V are
         appended at ``lengths`` and attention runs against the cache
         (:func:`cached_attention`) instead of ``cfg.attention_fn``;
-        returns ``(x, (k, v))`` with the updated buffers. ``None``
-        (training): the historical single-output signature, untouched.
+        returns ``(x, (k, v))`` with the updated buffers. A 5-tuple
+        ``(k_pool, v_pool, lengths, block_table, write_valid)`` selects
+        the PAGED cache path (ISSUE 7): appends scatter through the
+        block table (:func:`paged_cache_update`, ``write_valid`` [B, T]
+        masking padding/shared-prefix rows) and attention runs
+        ``cfg.paged_attention_fn`` (default the gather-dense
+        :func:`paged_cached_attention`). ``None`` (training): the
+        historical single-output signature, untouched.
         """
         cfg = self.cfg
         h = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln1")(x)
@@ -170,6 +246,17 @@ class Block(nn.Module):
         if layer_cache is None:
             attn = cfg.attention_fn(split(q), split(k), split(v), causal=True)
             new_cache = None
+        elif len(layer_cache) == 5:
+            k_pool, v_pool, lengths, block_table, write_valid = layer_cache
+            k_pool = paged_cache_update(
+                k_pool, split(k), lengths, block_table, valid=write_valid
+            )
+            v_pool = paged_cache_update(
+                v_pool, split(v), lengths, block_table, valid=write_valid
+            )
+            attn_fn = cfg.paged_attention_fn or paged_cached_attention
+            attn = attn_fn(split(q), k_pool, v_pool, lengths, block_table)
+            new_cache = (k_pool, v_pool)
         else:
             k_cache, v_cache, lengths = layer_cache
             k_cache = cache_update(k_cache, split(k), lengths)
@@ -193,7 +280,7 @@ class GPT2(nn.Module):
     @nn.compact
     def __call__(
         self, tokens, positions=None, targets=None, cache=None,
-        return_hidden=False,
+        paged_cache=None, return_hidden=False,
     ):
         """tokens [B, T] int32 → logits [B, T, vocab] float32.
 
@@ -218,25 +305,53 @@ class GPT2(nn.Module):
         padded prompt; decode = call with T = 1. Mutually exclusive with
         ``targets``.
 
-        ``return_hidden`` (serving; requires ``cache``): skip the LM-head
-        matmul and return the final post-``ln_f`` hidden states
-        ``[B, T, d_model]`` in place of logits — the blocked decode head
-        (:func:`mpit_tpu.ops.lm_head.lm_head_sample`) samples straight
-        from these, so the ``[B, T, vocab]`` f32 logits array never
-        exists in the decode step.
+        ``paged_cache`` (serving; ISSUE 7): ``(k_pools, v_pools,
+        lengths, block_tables, write_valid)`` with pools
+        ``[num_layers, num_pages, page_size, H, Dh]``, ``block_tables``
+        [B, pages_per_slot] int32 and ``write_valid`` [B, T] bool — the
+        paged analogue of ``cache``: K/V appends scatter through each
+        slot's block table (rows with ``write_valid`` False are
+        dropped, never written), attention runs
+        ``cfg.paged_attention_fn`` (default gather-dense reference),
+        and the return becomes ``(logits_or_hidden, (new_k_pools,
+        new_v_pools))``. Mutually exclusive with ``cache``/``targets``.
+
+        ``return_hidden`` (serving; requires ``cache``/``paged_cache``):
+        skip the LM-head matmul and return the final post-``ln_f``
+        hidden states ``[B, T, d_model]`` in place of logits — the
+        blocked decode head (:func:`mpit_tpu.ops.lm_head.lm_head_sample`)
+        samples straight from these, so the ``[B, T, vocab]`` f32
+        logits array never exists in the decode step.
         """
         cfg = self.cfg
-        if return_hidden and cache is None:
+        if return_hidden and cache is None and paged_cache is None:
             raise ValueError(
                 "return_hidden is the serving decode-head path; it "
-                "requires cache="
+                "requires cache= or paged_cache="
             )
-        if cache is not None:
-            if targets is not None:
-                raise ValueError(
-                    "cache and targets are mutually exclusive: the fused "
-                    "xent head never materializes the logits decode needs"
+        if paged_cache is not None and cache is not None:
+            raise ValueError("cache and paged_cache are mutually exclusive")
+        if (cache is not None or paged_cache is not None) and (
+            targets is not None
+        ):
+            raise ValueError(
+                "cache and targets are mutually exclusive: the fused "
+                "xent head never materializes the logits decode needs"
+            )
+        if paged_cache is not None:
+            pool_k, pool_v, cache_lengths, block_tables, write_valid = (
+                paged_cache
+            )
+            if positions is None:
+                # Junk rows (prefill padding past a slot's chunk) can
+                # push past the table — clip; their embeddings are
+                # discarded by the write mask / gather index anyway.
+                positions = jnp.minimum(
+                    cache_lengths[:, None]
+                    + jnp.arange(tokens.shape[-1])[None, :],
+                    cfg.max_seq_len - 1,
                 )
+        if cache is not None:
             cache_k, cache_v, cache_lengths = cache
             if positions is None:
                 positions = cache_lengths[:, None] + jnp.arange(
@@ -262,14 +377,22 @@ class GPT2(nn.Module):
             block = nn.remat(Block)
         new_k, new_v = [], []
         for i in range(cfg.num_layers):
-            if cache is None:
-                x = block(cfg, name=f"block_{i}")(x)
-            else:
+            if cache is not None:
                 x, (k_i, v_i) = block(cfg, name=f"block_{i}")(
                     x, (cache_k[i], cache_v[i], cache_lengths)
                 )
                 new_k.append(k_i)
                 new_v.append(v_i)
+            elif paged_cache is not None:
+                x, (k_i, v_i) = block(cfg, name=f"block_{i}")(
+                    x,
+                    (pool_k[i], pool_v[i], cache_lengths, block_tables,
+                     write_valid),
+                )
+                new_k.append(k_i)
+                new_v.append(v_i)
+            else:
+                x = block(cfg, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln_f")(x)
         if return_hidden:
             return x, (jnp.stack(new_k), jnp.stack(new_v))
@@ -297,7 +420,7 @@ class GPT2(nn.Module):
             head.astype(cfg.head_dtype),
             preferred_element_type=jnp.float32,
         )
-        if cache is not None:
+        if cache is not None or paged_cache is not None:
             return logits, (jnp.stack(new_k), jnp.stack(new_v))
         return logits
 

@@ -40,6 +40,17 @@ invariant at the token level.
 On non-TPU backends (``interpret=None``) the same math runs as the
 reference XLA path; ``interpret=True`` forces the kernel through the
 Pallas interpreter (the CPU-mesh test path, like the training kernel).
+
+**Paged variant (ISSUE 7).** :func:`flash_paged_decode_attention` runs
+the same length-aware flash loop against a PAGED pool
+(``[num_pages, page_size, H·D]``) instead of a dense per-slot buffer:
+the slot's int32 block table rides in SMEM next to ``lengths`` (scalar
+prefetch), and each k-tile's DMA source is resolved per tile —
+``page = bt[b, (ki·block_k)//page_size]``, offset ``(ki·block_k) %
+page_size`` — so the tile loop indirects through the table with zero
+extra HBM traffic (``page_size`` must be a multiple of ``block_k``:
+a tile never straddles pages). Skipped tiles still cost neither FLOPs
+nor HBM reads, and the heads-local/TP calling convention is unchanged.
 """
 
 from __future__ import annotations
@@ -54,7 +65,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = [
     "flash_decode_attention",
+    "flash_paged_decode_attention",
     "reference_decode_attention",
+    "reference_paged_decode_attention",
     "num_kv_blocks",
     "pick_block_k",
 ]
@@ -87,6 +100,16 @@ def reference_decode_attention(q, k, v, lengths):
     from mpit_tpu.models.gpt2 import cached_attention
 
     return cached_attention(q, k, v, lengths)
+
+
+def reference_paged_decode_attention(q, k_pool, v_pool, lengths, block_table):
+    """Gather-dense paged attention — delegates to
+    :func:`mpit_tpu.models.gpt2.paged_cached_attention` (one
+    implementation, same rationale as the dense reference above). The
+    paged kernel's oracle and the non-TPU fallback."""
+    from mpit_tpu.models.gpt2 import paged_cached_attention
+
+    return paged_cached_attention(q, k_pool, v_pool, lengths, block_table)
 
 
 def pick_block_k(s: int, want: int | None = None) -> int:
@@ -123,37 +146,56 @@ def num_kv_blocks(lengths, t_q: int, s: int, block_k: int):
 
 
 def _decode_kernel(
-    lengths_ref,  # [B] int32, SMEM (whole array; indexed by program)
-    q_ref,        # [1, T, H·D] VMEM tile
-    k_hbm,        # [B, S, H·D] ANY/HBM (full array)
-    v_hbm,
-    o_ref,        # [1, T, H·D] VMEM tile
-    visited_ref,  # [1, 1] int32 SMEM — tiles this program actually ran
-    k_buf,        # [2, block_k, H·D] VMEM scratch
-    v_buf,
-    sem,          # [2, 2] DMA semaphores (k/v × buffer slot)
-    *,
+    *refs,
     block_k,
     num_heads,
     head_dim,
     scale,
+    page_size=None,
 ):
+    """Flash-decode body, dense or paged.
+
+    Dense (``page_size=None``) refs: ``lengths_ref`` [B] int32 SMEM,
+    ``q_ref`` [1, T, H·D] VMEM, ``k_hbm``/``v_hbm`` [B, S, H·D]
+    ANY/HBM, ``o_ref``, ``visited_ref``, scratch. Paged adds ``bt_ref``
+    [B, pages_per_slot] int32 SMEM after ``lengths_ref`` and the HBM
+    operands become the [num_pages, page_size, H·D] pool — the ONLY
+    other difference is the DMA source: tile ``ki`` is resolved through
+    the block table instead of being a contiguous row slice. The flash
+    loop, masks and accumulators are byte-for-byte the same code.
+    """
+    if page_size is None:
+        (lengths_ref, q_ref, k_hbm, v_hbm, o_ref, visited_ref,
+         k_buf, v_buf, sem) = refs
+        bt_ref = None
+        s = k_hbm.shape[1]
+    else:
+        (lengths_ref, bt_ref, q_ref, k_hbm, v_hbm, o_ref, visited_ref,
+         k_buf, v_buf, sem) = refs
+        s = bt_ref.shape[1] * page_size  # virtual per-slot cache length
     b = pl.program_id(0)
     t_q = q_ref.shape[1]
-    s = k_hbm.shape[1]
     h_n, d = num_heads, head_dim
     length = lengths_ref[b]
 
     # Tiles with >= 1 visible key: ceil((L + T)/block_k), clamped to the
-    # buffer (a stale/retired slot's length can never overrun it).
+    # buffer (a stale/retired slot's length can never overrun it; in the
+    # paged case the clamp also bounds the block-table index, so a stale
+    # table entry past the mapped pages is never resolved).
     n_k = jnp.clip((length + t_q + block_k - 1) // block_k, 1, s // block_k)
     visited_ref[0, 0] = n_k
 
     def dma(which_hbm, which_buf, sem_row, slot, ki):
+        if bt_ref is None:
+            src = which_hbm.at[b, pl.ds(ki * block_k, block_k)]
+        else:
+            # page_size % block_k == 0 (validated at the call): a tile
+            # never straddles pages, so one SMEM lookup names its page.
+            page = bt_ref[b, (ki * block_k) // page_size]
+            src = which_hbm.at[page, pl.ds((ki * block_k) % page_size,
+                                           block_k)]
         return pltpu.make_async_copy(
-            which_hbm.at[b, pl.ds(ki * block_k, block_k)],
-            which_buf.at[slot],
-            sem.at[sem_row, slot],
+            src, which_buf.at[slot], sem.at[sem_row, slot]
         )
 
     dma(k_hbm, k_buf, 0, 0, 0).start()
@@ -271,6 +313,111 @@ def _decode_call(q, k, v, lengths, *, block_k, interpret):
         interpret=bool(interpret),
     )(jnp.asarray(lengths, jnp.int32), pk(q), pk(k), pk(v))
     return o.reshape(b, t, h, d), visited[:, 0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_k", "page_size", "interpret")
+)
+def _paged_decode_call(
+    q, k_pool, v_pool, lengths, block_table, *, block_k, page_size,
+    interpret,
+):
+    b, t, h, d = q.shape
+    hd = h * d
+    pk = lambda x: x.reshape(x.shape[0], x.shape[1], hd)  # free head-pack
+    kern = functools.partial(
+        _decode_kernel,
+        block_k=block_k,
+        num_heads=h,
+        head_dim=d,
+        scale=1.0 / (d ** 0.5),
+        page_size=page_size,
+    )
+    o, visited = pl.pallas_call(
+        kern,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths, whole [B]
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # block table [B, n_ps]
+            pl.BlockSpec(
+                (1, t, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # K pool stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),  # V pool stays in HBM
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, t, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t, hd), q.dtype, vma=_vma(q)),
+            jax.ShapeDtypeStruct((b, 1), jnp.int32, vma=_vma(q)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, block_k, hd), k_pool.dtype),
+            pltpu.VMEM((2, block_k, hd), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+        interpret=bool(interpret),
+    )(
+        jnp.asarray(lengths, jnp.int32),
+        jnp.asarray(block_table, jnp.int32),
+        pk(q), pk(k_pool), pk(v_pool),
+    )
+    return o.reshape(b, t, h, d), visited[:, 0]
+
+
+def flash_paged_decode_attention(
+    q,
+    k_pool,
+    v_pool,
+    lengths,
+    block_table,
+    *,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+    return_visited: bool = False,
+):
+    """Length-aware attention against the PAGED KV pool (ISSUE 7):
+    ``[B, T, H, Dh]`` queries vs ``[num_pages, page_size, H, Dh]``
+    pools, each slot's pages named by ``block_table``
+    [B, pages_per_slot] int32.
+
+    Drop-in for :func:`mpit_tpu.models.gpt2.paged_cached_attention`
+    (plug in as ``GPT2Config.paged_attention_fn``). The tile loop and
+    skipping are exactly :func:`flash_decode_attention`'s over the
+    slot's virtual ``pages_per_slot × page_size`` cache; only the DMA
+    source indirects through the table. ``block_k`` defaults to the
+    largest :func:`pick_block_k` choice for ``page_size`` and must
+    divide it (a tile never straddles pages). ``interpret`` /
+    ``return_visited`` as in :func:`flash_decode_attention` (the
+    non-TPU fallback is the gather-dense reference)."""
+    page_size = k_pool.shape[1]
+    bk = pick_block_k(page_size, block_k)
+    if page_size % bk:
+        raise ValueError(
+            f"page_size {page_size} must be divisible by block_k={bk}"
+        )
+    s_virtual = block_table.shape[1] * page_size
+    if not _use_kernel(interpret):
+        out = reference_paged_decode_attention(
+            q, k_pool, v_pool, lengths, block_table
+        )
+        if return_visited:
+            return out, num_kv_blocks(
+                jnp.asarray(lengths, jnp.int32), q.shape[1], s_virtual, bk
+            )
+        return out
+    out, visited = _paged_decode_call(
+        q, k_pool, v_pool, lengths, block_table,
+        block_k=bk, page_size=page_size,
+        interpret=bool(interpret) if interpret is not None else False,
+    )
+    return (out, visited) if return_visited else out
 
 
 def flash_decode_attention(

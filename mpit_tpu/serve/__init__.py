@@ -43,7 +43,16 @@ random-init), serve a synthetic request stream, print the obs summary.
 """
 
 from mpit_tpu.serve.engine import Engine, sample_tokens
-from mpit_tpu.serve.kvcache import KVCache, alloc_cache, cache_specs
+from mpit_tpu.serve.kvcache import (
+    KVCache,
+    PageAllocator,
+    PagedKVCache,
+    alloc_cache,
+    alloc_paged_cache,
+    cache_specs,
+    paged_cache_specs,
+    pages_needed,
+)
 from mpit_tpu.serve.loadgen import (
     Arrival,
     LoadSpec,
@@ -64,11 +73,16 @@ __all__ = [
     "Engine",
     "KVCache",
     "LoadSpec",
+    "PageAllocator",
+    "PagedKVCache",
     "Request",
     "RequestClass",
     "Server",
     "alloc_cache",
+    "alloc_paged_cache",
     "cache_specs",
+    "paged_cache_specs",
+    "pages_needed",
     "expected_param_shapes",
     "generate_arrivals",
     "infer_config",

@@ -19,6 +19,15 @@ Two drive modes (ISSUE 6):
   req/s, tokens/s, occupancy, queue depth) fed from the
   ``obs.stream`` registry — not from the Recorder's bounded buffer.
 
+``--kv-pages N`` (ISSUE 7) selects the PAGED engine: a fixed pool of
+``--kv-page-size``-token pages shared by all slots (HBM scales with
+tokens actually held, not slots × max-len), copy-on-write prefix
+sharing keyed on prompt prefixes (drive it with ``--loadgen
+"...,prefix=32"``), and ``--prefill-chunk`` slicing long admits across
+decode ticks; the live stats line grows ``kv=`` (pool occupancy),
+``kvtok=`` (tokens cached) and ``shr=`` (pages stored once, mapped by
+several requests).
+
 ``--slo-ttft-p95 / --slo-latency-p95 / --slo-shed-rate`` declare SLO
 targets; an ``obs.slo.SLOMonitor`` evaluates them over the rolling
 windows each tick, breaches land in the trace / the sentinel, and the
@@ -65,6 +74,15 @@ class ServeConfig:
     # kernel/interpret modes (submit rejects top_k > this). Grown here
     # so the remedy the rejection names is reachable from the CLI.
     sample_k_cap: int = 128
+    # Paged KV cache (ISSUE 7). kv_pages > 0 selects the paged engine:
+    # HBM holds kv_pages × kv_page_size cache rows shared by all slots
+    # (max_len becomes a per-slot VIRTUAL capacity), prompts sharing a
+    # prefix map the same pages copy-on-write, and prefill_chunk > 0
+    # slices long admits across ticks so they can't head-of-line-block
+    # decode (0 = whole-prompt chunks).
+    kv_pages: int = 0
+    kv_page_size: int = 16
+    prefill_chunk: int = 0
     mesh: str = ""  # e.g. "model=2" -> TP engine over that axis
     sentinel: bool = False  # decode/prefill tick anomaly sentinel
     trace: str = ""  # write a Chrome trace of the run here
@@ -127,6 +145,12 @@ def _build_engine(cfg: ServeConfig):
         seed=cfg.seed,
         decode_attention=cfg.decode_attention,
         sample_k_cap=max(cfg.sample_k_cap, cfg.top_k),
+        kv_pages=cfg.kv_pages or None,
+        kv_page_size=cfg.kv_page_size,
+        # Passed through unconditionally: --prefill-chunk without
+        # --kv-pages must surface the Engine's "paged-engine knob"
+        # rejection, not silently run whole-prompt prefills.
+        prefill_chunk=cfg.prefill_chunk or None,
     )
     return engine, mcfg
 
@@ -182,6 +206,15 @@ def _live_line(registry, monitor, server, now: float) -> str:
         f"q={g.get('queue_depth', 0.0):.0f} "
         f"done={len(server.completed)} shed={len(server.shed)}"
     )
+    if "kv_pool_occupancy" in g:
+        # Cache-MEMORY efficiency next to slot occupancy (ISSUE 7):
+        # pool fill, tokens actually held, pages stored once but
+        # mapped by multiple requests.
+        line += (
+            f" kv={g['kv_pool_occupancy']:.2f}"
+            f" kvtok={g.get('kv_tokens_cached', 0.0):.0f}"
+            f" shr={g.get('prefix_pages_shared', 0.0):.0f}"
+        )
     if monitor is not None:
         breached = [
             name
@@ -224,17 +257,17 @@ def main(argv: list[str] | None = None) -> dict:
         # request as a caller bug, and for the CLI the caller is the
         # spec/geometry pair given right here.
         for klass in spec.classes:
-            if klass.prompt_len[1] > cfg.prefill_len:
+            if klass.max_prompt_total > cfg.prefill_len:
                 raise SystemExit(
-                    f"--loadgen class {klass.name!r}: prompt_max "
-                    f"{klass.prompt_len[1]} > --prefill-len "
+                    f"--loadgen class {klass.name!r}: prefix + prompt_max "
+                    f"{klass.max_prompt_total} > --prefill-len "
                     f"{cfg.prefill_len}"
                 )
-            need = klass.prompt_len[1] + klass.max_new_tokens[1]
+            need = klass.max_prompt_total + klass.max_new_tokens[1]
             if need > cfg.max_len:
                 raise SystemExit(
-                    f"--loadgen class {klass.name!r}: prompt_max + "
-                    f"new_max = {need} > --max-len {cfg.max_len}"
+                    f"--loadgen class {klass.name!r}: prefix + prompt_max "
+                    f"+ new_max = {need} > --max-len {cfg.max_len}"
                 )
         # Warm the engine's two compiles OUTSIDE the timed window — an
         # open-loop harness that pays multi-second XLA compiles inside

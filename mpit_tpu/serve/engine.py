@@ -26,9 +26,23 @@ cache sharded on the head dim (``kvcache.cache_specs``). Embeddings and
 the LM head stay replicated (decode is latency-bound on the blocks; the
 head matmul at T=1 is negligible).
 
-Host surface: :meth:`Engine.prefill` / :meth:`Engine.decode` — the
-scheduler (``serve.scheduler``) owns queueing, retirement and
-observability around them.
+Paged engine (ISSUE 7): ``Engine(kv_pages=N, kv_page_size=ps)`` swaps
+the dense per-slot cache for the shared page pool
+(``serve.kvcache.PagedKVCache`` + host ``PageAllocator``): K/V appends
+scatter through per-slot block tables (masked rows dropped, so a padded
+chunk can never touch a page the slot does not own), attention runs the
+paged flash-decode kernel (or the gather-dense reference), and
+``max_len`` becomes a VIRTUAL per-slot capacity — HBM scales with
+``kv_pages × kv_page_size``, not ``slots × max_len``. ``prefill_chunk``
+fixes the traced prefill width so the scheduler can slice long admits
+across ticks (chunked prefill); still exactly two compiles (+ the tiny
+COW page-copy). Same step count, same calling convention under TP.
+
+Host surface: :meth:`Engine.prefill` (dense) /
+:meth:`Engine.prefill_paged` + :meth:`Engine.copy_page` (paged) /
+:meth:`Engine.decode` — the scheduler (``serve.scheduler``) owns
+queueing, admission (page allocation, COW, prefix registration),
+retirement and observability around them.
 """
 
 from __future__ import annotations
@@ -46,10 +60,24 @@ from mpit_tpu.models.gpt2 import (
     GPT2Config,
     cache_update,
     cached_attention,
+    paged_cache_update,
+    paged_cached_attention,
 )
-from mpit_tpu.ops.decode_attention import flash_decode_attention, pick_block_k
+from mpit_tpu.ops.decode_attention import (
+    flash_decode_attention,
+    flash_paged_decode_attention,
+    pick_block_k,
+)
 from mpit_tpu.ops.lm_head import lm_head_sample
-from mpit_tpu.serve.kvcache import KVCache, alloc_cache, cache_specs
+from mpit_tpu.serve.kvcache import (
+    KVCache,
+    PageAllocator,
+    PagedKVCache,
+    alloc_cache,
+    alloc_paged_cache,
+    cache_specs,
+    paged_cache_specs,
+)
 
 __all__ = ["Engine", "sample_tokens"]
 
@@ -92,11 +120,18 @@ def sample_tokens(logits, key, temperature, top_k):
 # ---------------------------------------------------------------------------
 
 
-def _tp_cache_forward(
-    params, tokens, cache: KVCache, *, cfg, axis, attn_fn=None,
-    with_head=True,
+def _tp_forward_body(
+    params, tokens, lengths, *, cfg, axis, layer_kv, with_head,
+    clip_positions=False,
 ):
-    """Cache-aware GPT-2 forward INSIDE shard_map over the TP axis.
+    """The shared cache-aware GPT-2 transformer loop INSIDE shard_map
+    over the TP axis — dense and paged differ ONLY in how a layer's
+    fresh K/V lands in the cache and what attention reads, injected as
+    ``layer_kv(i, q, k, v) -> (k_i, v_i, attn)`` (heads-local
+    [B, T, H/P, Dh] operands). Everything else — embeddings, the
+    megatron column/row-parallel block structure, ln_f, the optional
+    replicated head — is one implementation, so the dense/paged
+    bit-match parity the tests pin cannot silently diverge.
 
     The per-device view: block matmul kernels arrive sharded per
     ``megatron.tp_block_specs`` (qkv in ``repack_qkv`` layout), the KV
@@ -104,7 +139,10 @@ def _tp_cache_forward(
     replicated. Numerics mirror ``models.gpt2`` block-for-block —
     ``megatron.layernorm`` is the parity-tested nn.LayerNorm
     equivalent; each half closes on a psum (row-parallel proj/out).
-    Returns replicated logits + this device's updated cache shard.
+    ``clip_positions`` (paged chunking): padding rows past a slot's
+    chunk can push past max_seq_len — clip; their embeddings are
+    write-masked / never attended anyway. Returns replicated
+    logits-or-hiddens + per-layer (k, v) lists.
     """
     from jax import lax
 
@@ -113,7 +151,9 @@ def _tp_cache_forward(
     p = lax.axis_size(axis)
     heads_local = cfg.num_heads // p
     t = tokens.shape[-1]
-    positions = cache.lengths[:, None] + jnp.arange(t)[None, :]
+    positions = lengths[:, None] + jnp.arange(t)[None, :]
+    if clip_positions:
+        positions = jnp.minimum(positions, cfg.max_seq_len - 1)
     x = params["wte"][tokens].astype(cfg.dtype) + params["wpe"][
         positions
     ].astype(cfg.dtype)
@@ -128,13 +168,7 @@ def _tp_cache_forward(
             h, blk["qkv"]["kernel"].astype(dt), blk["qkv"]["bias"].astype(dt)
         )
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        k_i = cache_update(cache.k[i], split(k), cache.lengths)
-        v_i = cache_update(cache.v[i], split(v), cache.lengths)
-        # Heads-local by construction (kernel or reference): this
-        # device's H/P head shard of the cache goes in unchanged.
-        attn = (attn_fn or cached_attention)(
-            split(q), k_i, v_i, cache.lengths
-        )
+        k_i, v_i, attn = layer_kv(i, split(q), split(k), split(v))
         attn = attn.reshape(*attn.shape[:-2], -1)
         x = x + M.row_parallel_dense(
             attn,
@@ -158,14 +192,11 @@ def _tp_cache_forward(
         new_v.append(v_i)
 
     x = M.layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
-    new_cache = KVCache(
-        k=jnp.stack(new_k), v=jnp.stack(new_v), lengths=cache.lengths
-    )
     if not with_head:
         # Blocked decode head: the replicated post-ln_f hiddens go back
         # to the jitted step, which samples via lm_head_sample — no
         # [B, T, vocab] logits here either.
-        return x, new_cache
+        return x, (new_k, new_v)
     head = params.get("head", params["wte"])
     logits = jnp.einsum(
         "btd,vd->btv",
@@ -173,7 +204,67 @@ def _tp_cache_forward(
         head.astype(cfg.head_dtype),
         preferred_element_type=jnp.float32,
     )
-    return logits, new_cache
+    return logits, (new_k, new_v)
+
+
+def _tp_cache_forward(
+    params, tokens, cache: KVCache, *, cfg, axis, attn_fn=None,
+    with_head=True,
+):
+    """Dense-cache TP forward: :func:`_tp_forward_body` with per-slot
+    buffer appends at ``lengths``. Returns replicated logits (or
+    hiddens) + this device's updated cache shard."""
+
+    def layer_kv(i, q, k, v):
+        k_i = cache_update(cache.k[i], k, cache.lengths)
+        v_i = cache_update(cache.v[i], v, cache.lengths)
+        # Heads-local by construction (kernel or reference): this
+        # device's H/P head shard of the cache goes in unchanged.
+        attn = (attn_fn or cached_attention)(q, k_i, v_i, cache.lengths)
+        return k_i, v_i, attn
+
+    out, (new_k, new_v) = _tp_forward_body(
+        params, tokens, cache.lengths, cfg=cfg, axis=axis,
+        layer_kv=layer_kv, with_head=with_head,
+    )
+    return out, KVCache(
+        k=jnp.stack(new_k), v=jnp.stack(new_v), lengths=cache.lengths
+    )
+
+
+def _tp_paged_forward(
+    params, tokens, cache: PagedKVCache, block_tables, write_valid, *,
+    cfg, axis, attn_fn=None, with_head=True,
+):
+    """Paged-cache TP forward (ISSUE 7): :func:`_tp_forward_body` with
+    the per-slot dense buffers swapped for this device's H/P head shard
+    of the page pool — K/V appends scatter through the (replicated)
+    block tables with ``write_valid``-masked rows dropped, attention
+    runs ``attn_fn`` (default the gather-dense
+    :func:`paged_cached_attention`; the serving engine plugs the paged
+    flash kernel) against the pool. Numerics per position are identical
+    to the dense TP forward — the pool is just a different placement of
+    the same rows."""
+
+    def layer_kv(i, q, k, v):
+        k_i = paged_cache_update(
+            cache.k[i], k, cache.lengths, block_tables, valid=write_valid
+        )
+        v_i = paged_cache_update(
+            cache.v[i], v, cache.lengths, block_tables, valid=write_valid
+        )
+        attn = (attn_fn or paged_cached_attention)(
+            q, k_i, v_i, cache.lengths, block_tables
+        )
+        return k_i, v_i, attn
+
+    out, (new_k, new_v) = _tp_forward_body(
+        params, tokens, cache.lengths, cfg=cfg, axis=axis,
+        layer_kv=layer_kv, with_head=with_head, clip_positions=True,
+    )
+    return out, PagedKVCache(
+        k=jnp.stack(new_k), v=jnp.stack(new_v), lengths=cache.lengths
+    )
 
 
 def _tp_param_specs(cfg, params, axis: str):
@@ -218,6 +309,9 @@ class Engine:
         decode_block_k: int | None = None,
         sample_block: int = 8192,
         sample_k_cap: int = 128,
+        kv_pages: int | None = None,
+        kv_page_size: int = 16,
+        prefill_chunk: int | None = None,
     ):
         if decode_attention not in _DECODE_MODES:
             raise ValueError(
@@ -231,19 +325,66 @@ class Engine:
         self.tp_axis = tp_axis
         self._key = jax.random.key(seed)
 
+        # -- paged KV pool (ISSUE 7 tentpole) --------------------------------
+        # kv_pages selects the paged engine: HBM holds a fixed pool of
+        # page_size-token pages shared by all slots, indirected by the
+        # host allocator's per-slot block tables; max_len becomes the
+        # per-slot VIRTUAL capacity (pages_per_slot × page_size), not an
+        # HBM reservation. prefill_chunk splits long admits into chunk
+        # slices interleaved with decode ticks (scheduler-driven).
+        self.paged = kv_pages is not None
+        if self.paged:
+            if kv_pages < 1:
+                raise ValueError(f"kv_pages must be >= 1, got {kv_pages}")
+            if kv_page_size < 1 or self.max_len % kv_page_size:
+                raise ValueError(
+                    f"kv_page_size {kv_page_size} must divide "
+                    f"max_len={self.max_len} (pages_per_slot must be whole)"
+                )
+            self.page_size = kv_page_size
+            self.num_pages = kv_pages
+            self.pages_per_slot = self.max_len // kv_page_size
+        elif prefill_chunk is not None:
+            raise ValueError(
+                "prefill_chunk is the paged engine's chunked-prefill "
+                "knob; the dense cache prefills whole prompts (pass "
+                "kv_pages=)"
+            )
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        # The traced chunk-buffer width: every prefill chunk (including
+        # an unchunked whole-prompt admit) runs at this static shape —
+        # one compile for the engine's lifetime, as in PR 4.
+        self.prefill_chunk = min(
+            prefill_chunk or self.prefill_len, self.prefill_len
+        )
+
         # -- serving hot-loop shape (ISSUE 5): attention kernel + head --
         self.decode_attention = decode_attention
-        self.decode_block_k = pick_block_k(self.max_len, decode_block_k)
-        if self.max_len % self.decode_block_k:
-            # Fail at construction, not at the first traced prefill —
-            # and never let the reference fallback run with tile
-            # accounting (skip counter, bench kv_blocks_*) that doesn't
-            # describe a real tiling.
-            raise ValueError(
-                f"decode_block_k={self.decode_block_k} does not divide "
-                f"max_len={self.max_len}; pick a divisor or omit it for "
-                "the auto choice"
-            )
+        if self.paged:
+            # Tiles must never straddle pages: block_k divides page_size
+            # (one SMEM block-table lookup names a tile's page).
+            self.decode_block_k = pick_block_k(self.page_size, decode_block_k)
+            if self.page_size % self.decode_block_k:
+                raise ValueError(
+                    f"decode_block_k={self.decode_block_k} does not divide "
+                    f"kv_page_size={self.page_size}; pick a divisor or omit "
+                    "it for the auto choice"
+                )
+        else:
+            self.decode_block_k = pick_block_k(self.max_len, decode_block_k)
+            if self.max_len % self.decode_block_k:
+                # Fail at construction, not at the first traced prefill —
+                # and never let the reference fallback run with tile
+                # accounting (skip counter, bench kv_blocks_*) that doesn't
+                # describe a real tiling.
+                raise ValueError(
+                    f"decode_block_k={self.decode_block_k} does not divide "
+                    f"max_len={self.max_len}; pick a divisor or omit it for "
+                    "the auto choice"
+                )
         self._sample_block = sample_block
         platform = jax.devices()[0].platform
         if decode_attention == "reference":
@@ -253,7 +394,9 @@ class Engine:
         else:
             interp = True if decode_attention == "interpret" else None
             attn_fn = functools.partial(
-                flash_decode_attention,
+                flash_paged_decode_attention
+                if self.paged
+                else flash_decode_attention,
                 block_k=self.decode_block_k,
                 interpret=interp,
             )
@@ -276,7 +419,14 @@ class Engine:
         # attention=reference + sampler=dense is the true PR 4 path.
         self.decode_sampler = "blocked" if self._blocked_head else "dense"
         if attn_fn is not None:
-            cfg = dataclasses.replace(cfg, cache_attention_fn=attn_fn)
+            cfg = dataclasses.replace(
+                cfg,
+                **{
+                    "paged_attention_fn"
+                    if self.paged
+                    else "cache_attention_fn": attn_fn
+                },
+            )
             self.cfg = cfg  # what the forward really runs, kernel included
 
         sharding = None
@@ -304,16 +454,43 @@ class Engine:
                     ),
                 ),
             )
-            cs = cache_specs(tp_axis)
-            sharding = world.sharding(*cs.k)
-            fwd = world.shard_map(
-                functools.partial(
-                    _tp_cache_forward, cfg=cfg, axis=tp_axis,
-                    attn_fn=attn_fn, with_head=not self._blocked_head,
-                ),
-                in_specs=(self._specs, jax.sharding.PartitionSpec(), cs),
-                out_specs=(jax.sharding.PartitionSpec(), cs),
-            )
+            if self.paged:
+                cs = paged_cache_specs(tp_axis)
+                sharding = world.sharding(*cs.k)
+                rep = jax.sharding.PartitionSpec()
+                fwd = world.shard_map(
+                    functools.partial(
+                        _tp_paged_forward, cfg=cfg, axis=tp_axis,
+                        attn_fn=attn_fn, with_head=not self._blocked_head,
+                    ),
+                    in_specs=(self._specs, rep, cs, rep, rep),
+                    out_specs=(rep, cs),
+                )
+            else:
+                cs = cache_specs(tp_axis)
+                sharding = world.sharding(*cs.k)
+                fwd = world.shard_map(
+                    functools.partial(
+                        _tp_cache_forward, cfg=cfg, axis=tp_axis,
+                        attn_fn=attn_fn, with_head=not self._blocked_head,
+                    ),
+                    in_specs=(self._specs, jax.sharding.PartitionSpec(), cs),
+                    out_specs=(jax.sharding.PartitionSpec(), cs),
+                )
+        elif self.paged:
+            model = GPT2(cfg)
+
+            def fwd(prms, tokens, cache: PagedKVCache, block_tables,
+                    write_valid):
+                out, (k2, v2) = model.apply(
+                    {"params": prms},
+                    tokens,
+                    paged_cache=(cache.k, cache.v, cache.lengths,
+                                 block_tables, write_valid),
+                    return_hidden=self._blocked_head,
+                )
+                return out, PagedKVCache(k=k2, v=v2, lengths=cache.lengths)
+
         else:
             model = GPT2(cfg)
 
@@ -329,13 +506,29 @@ class Engine:
                 return out, KVCache(k=k2, v=v2, lengths=cache.lengths)
 
         self.params = params
-        self.cache = alloc_cache(
-            cfg, slots, self.max_len, sharding=sharding
-        )
+        if self.paged:
+            # Host-side page bookkeeping: free list, refcounts, prefix
+            # index, COW reservations, per-slot block tables (the tables
+            # ride into every jitted step as a tiny int32 argument).
+            self.allocator = PageAllocator(
+                self.num_pages, self.page_size, self.pages_per_slot, slots
+            )
+            self.cache = alloc_paged_cache(
+                cfg, slots, self.num_pages, self.page_size,
+                sharding=sharding,
+            )
+            self._prefill_paged_jit = jax.jit(self._paged_prefill_step)
+            self._decode_paged_jit = jax.jit(self._paged_decode_step)
+            self._copy_page_jit = jax.jit(self._copy_page_step)
+        else:
+            self.allocator = None
+            self.cache = alloc_cache(
+                cfg, slots, self.max_len, sharding=sharding
+            )
+            self._prefill_jit = jax.jit(self._prefill_step)
+            self._decode_jit = jax.jit(self._decode_step)
         self.last_token = jnp.zeros((slots,), jnp.int32)
         self._forward = fwd
-        self._prefill_jit = jax.jit(self._prefill_step)
-        self._decode_jit = jax.jit(self._decode_step)
 
     # -- jitted step bodies -------------------------------------------------
     def _sample_last(self, params, out, gather_idx, key, temp, topk):
@@ -405,6 +598,90 @@ class Engine:
             jnp.where(active, tok, last),
         )
 
+    # -- paged jitted step bodies (ISSUE 7) ---------------------------------
+    def _paged_prefill_step(
+        self, params, cache, last, tokens, base, chunk_lens, floor,
+        sample_mask, block_tables, key, temp, topk,
+    ):
+        """One prefill CHUNK over the whole slot batch: slot ``s`` feeds
+        ``tokens[s, :chunk_lens[s]]`` = its prompt slice starting at
+        position ``base[s]`` (tokens already cached per slot — 0 cold,
+        the shared-prefix floor on a prefix hit, the running total on
+        later chunks of a chunked admit). K/V appends scatter through
+        the block tables; rows below ``floor`` (shared pages are
+        immutable — the values would be bit-identical anyway), padding
+        rows past the chunk, and non-participating slots' rows are all
+        DROPPED, never written. ``sample_mask`` marks slots whose final
+        prompt token rides this chunk: their first output token is
+        sampled from the logits at that position and sticks."""
+        t_idx = jnp.arange(tokens.shape[1])[None, :]
+        pos = base[:, None] + t_idx
+        write_valid = (t_idx < chunk_lens[:, None]) & (pos >= floor[:, None])
+        # Non-participants (live/free slots riding the fixed batch
+        # shape) attend at length 0 — their compute is discarded and the
+        # length-aware kernel pays 1 tile, not their real context.
+        participates = chunk_lens > 0
+        work = PagedKVCache(
+            k=cache.k, v=cache.v,
+            lengths=jnp.where(participates, base, 0),
+        )
+        out, new = self._forward(
+            params, tokens, work, block_tables, write_valid
+        )
+        tok = self._sample_last(
+            params, out, jnp.maximum(chunk_lens - 1, 0), key, temp, topk
+        )
+        return (
+            PagedKVCache(
+                k=new.k,
+                v=new.v,
+                lengths=jnp.where(
+                    participates, base + chunk_lens, cache.lengths
+                ),
+            ),
+            jnp.where(sample_mask, tok, last),
+        )
+
+    def _paged_decode_step(
+        self, params, cache, last, active, block_tables, key, temp, topk
+    ):
+        """One decode tick through the page pool: append each active
+        slot's last token at its fill position (scatter through its
+        block table; inactive rows dropped), attend, sample the next."""
+        lens = jnp.where(active, cache.lengths, 0)
+        work = PagedKVCache(k=cache.k, v=cache.v, lengths=lens)
+        out, new = self._forward(
+            params, last[:, None], work, block_tables, active[:, None]
+        )
+        tok = self._sample_last(
+            params, out,
+            jnp.zeros((out.shape[0],), jnp.int32), key, temp, topk,
+        )
+        return (
+            PagedKVCache(
+                k=new.k, v=new.v,
+                lengths=jnp.where(active, lens + 1, lens),
+            ),
+            jnp.where(active, tok, last),
+        )
+
+    def _copy_page_step(self, cache, src, dst):
+        """Copy pool page ``src`` → ``dst`` across every layer, K and V
+        — the device half of a copy-on-write remap (the allocator
+        already repointed the block table at ``dst``)."""
+
+        def cp(pool):
+            page = jax.lax.dynamic_index_in_dim(
+                pool, src, axis=1, keepdims=True
+            )
+            return jax.lax.dynamic_update_slice_in_dim(
+                pool, page, dst, axis=1
+            )
+
+        return PagedKVCache(
+            k=cp(cache.k), v=cp(cache.v), lengths=cache.lengths
+        )
+
     # -- host surface (the scheduler's API) ---------------------------------
     def _split(self):
         self._key, sub = jax.random.split(self._key)
@@ -415,6 +692,11 @@ class Engine:
         ``prompt_lens``/``admit``/``temp``/``topk`` [slots]. Returns the
         per-slot last token (the first OUTPUT token for admitted slots)
         as host numpy — the fetch is the step's completion fence."""
+        if self.paged:
+            raise ValueError(
+                "the paged engine prefills through prefill_paged (block-"
+                "table writes + chunking); the dense prefill has no pages"
+            )
         self.cache, self.last_token = self._prefill_jit(
             self.params,
             self.cache,
@@ -428,9 +710,59 @@ class Engine:
         )
         return np.asarray(self.last_token)
 
+    def prefill_paged(
+        self, tokens, base, chunk_lens, floor, sample_mask, temp, topk
+    ) -> np.ndarray:
+        """One prefill chunk over the slot batch (paged engine):
+        ``tokens`` [slots, prefill_chunk] int32 (padded slices),
+        ``base``/``chunk_lens``/``floor`` [slots] int32 and
+        ``sample_mask`` [slots] bool per :meth:`_paged_prefill_step`.
+        Block tables come from the engine's allocator. Returns the
+        per-slot last token (the first OUTPUT token for slots whose
+        ``sample_mask`` is set) as host numpy."""
+        if not self.paged:
+            raise ValueError("prefill_paged requires Engine(kv_pages=...)")
+        self.cache, self.last_token = self._prefill_paged_jit(
+            self.params,
+            self.cache,
+            self.last_token,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(base, jnp.int32),
+            jnp.asarray(chunk_lens, jnp.int32),
+            jnp.asarray(floor, jnp.int32),
+            jnp.asarray(sample_mask, bool),
+            jnp.asarray(self.allocator.block_tables, jnp.int32),
+            self._split(),
+            jnp.asarray(temp, jnp.float32),
+            jnp.asarray(topk, jnp.int32),
+        )
+        return np.asarray(self.last_token)
+
+    def copy_page(self, src: int, dst: int) -> None:
+        """Device half of a COW remap: copy pool page ``src`` → ``dst``
+        (all layers, K and V). Page ids ride as traced scalars — one
+        compile serves every copy."""
+        self.cache = self._copy_page_jit(
+            self.cache,
+            jnp.asarray(src, jnp.int32),
+            jnp.asarray(dst, jnp.int32),
+        )
+
     def decode(self, active, temp, topk) -> np.ndarray:
         """One decode tick over the slot batch; returns the per-slot
         next token (host numpy; stale for inactive slots)."""
+        if self.paged:
+            self.cache, self.last_token = self._decode_paged_jit(
+                self.params,
+                self.cache,
+                self.last_token,
+                jnp.asarray(active, bool),
+                jnp.asarray(self.allocator.block_tables, jnp.int32),
+                self._split(),
+                jnp.asarray(temp, jnp.float32),
+                jnp.asarray(topk, jnp.int32),
+            )
+            return np.asarray(self.last_token)
         self.cache, self.last_token = self._decode_jit(
             self.params,
             self.cache,
@@ -447,10 +779,13 @@ class Engine:
 
     def reset(self, seed: int = 0) -> None:
         """Clear all slots (bench warmup path); compiled steps survive."""
-        self.cache = KVCache(
+        cls = PagedKVCache if self.paged else KVCache
+        self.cache = cls(
             k=jnp.zeros_like(self.cache.k),
             v=jnp.zeros_like(self.cache.v),
             lengths=jnp.zeros_like(self.cache.lengths),
         )
         self.last_token = jnp.zeros_like(self.last_token)
         self._key = jax.random.key(seed)
+        if self.paged:
+            self.allocator.reset()

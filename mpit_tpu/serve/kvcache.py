@@ -23,6 +23,21 @@ step runs over the whole slot batch. Layout rationale:
 Under tensor parallelism the head dim shards over the TP axis
 (:func:`cache_specs`) — each device holds its H/P heads' cache, matching
 the Megatron column-sharded qkv layout (``parallel.megatron``).
+
+PAGED cache (ISSUE 7 tentpole). The dense layout makes HBM cost scale
+with ``slots × max_len`` whether or not the tokens exist: a slot holding
+30 cached tokens pays for 1024, slot count is the hard concurrency
+ceiling, and two requests sharing a system prompt store identical K/V
+twice. :class:`PagedKVCache` breaks the buffers into a fixed pool of
+``page_size``-token pages (``[layers, num_pages, page_size, heads,
+head_dim]``) indirected by a per-slot int32 block table: HBM scales with
+tokens actually held, and a page mapped into two block tables IS prefix
+sharing. The device side stays dumb — pages are just rows, the pool
+never moves — while :class:`PageAllocator` (pure host) owns the free
+list, per-page refcounts, the rolling-hash prefix index and the
+copy-on-write bookkeeping. Validity still comes from ``lengths`` + the
+attention mask, never from buffer contents, so freed pages are recycled
+without zeroing.
 """
 
 from __future__ import annotations
@@ -32,9 +47,20 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["KVCache", "alloc_cache", "cache_specs"]
+__all__ = [
+    "KVCache",
+    "alloc_cache",
+    "cache_specs",
+    "PagedKVCache",
+    "alloc_paged_cache",
+    "paged_cache_specs",
+    "PageAllocator",
+    "AdmitPlan",
+    "pages_needed",
+]
 
 
 @jax.tree_util.register_pytree_node_class
@@ -100,3 +126,360 @@ def cache_specs(axis: str = "model") -> KVCache:
     ``in_specs``/``out_specs`` positionally."""
     kv = P(None, None, None, axis, None)
     return KVCache(k=kv, v=kv, lengths=P())
+
+
+# ---------------------------------------------------------------------------
+# Paged pool (ISSUE 7): fixed-size pages + per-slot block tables.
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PagedKVCache:
+    """Paged decode state: one shared page pool + per-slot fill counts.
+
+    ``k``/``v``: [num_layers, num_pages, page_size, heads, head_dim];
+    ``lengths``: [slots] int32. The per-slot page→position mapping (the
+    block table) is NOT device state — it lives host-side on the
+    :class:`PageAllocator` and rides into each jitted step as a tiny
+    [slots, pages_per_slot] int32 argument, so COW remaps and admissions
+    never touch the pool.
+    """
+
+    k: Any
+    v: Any
+    lengths: Any
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def slots(self) -> int:
+        return self.lengths.shape[0]
+
+
+def alloc_paged_cache(
+    cfg,
+    slots: int,
+    num_pages: int,
+    page_size: int,
+    *,
+    dtype=None,
+    sharding=None,
+) -> PagedKVCache:
+    """Allocate the zeroed page pool. HBM cost is ``num_pages ×
+    page_size`` cache rows — chosen by budget, independent of ``slots``
+    (the batch width) and of any per-slot ``max_len``."""
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_heads,
+             cfg.head_dim)
+    dt = dtype or cfg.dtype
+    kw = {"device": sharding} if sharding is not None else {}
+    return PagedKVCache(
+        k=jnp.zeros(shape, dt, **kw),
+        v=jnp.zeros(shape, dt, **kw),
+        lengths=jnp.zeros((slots,), jnp.int32),
+    )
+
+
+def paged_cache_specs(axis: str = "model") -> PagedKVCache:
+    """TP PartitionSpecs for the pool: heads (axis 3 of [L, P, ps, H,
+    Dh]) shard exactly as the dense cache's; pages are replicated-id
+    shared state, lengths replicated."""
+    kv = P(None, None, None, axis, None)
+    return PagedKVCache(k=kv, v=kv, lengths=P())
+
+
+def pages_needed(prompt_len: int, max_new_tokens: int, page_size: int) -> int:
+    """Pages a request can ever touch. The scheduler's write sequence
+    (see ``serve.scheduler``): prefill writes positions
+    ``0..prompt_len-1``; decode tick ``t`` appends ONE K/V row at
+    position ``prompt_len + t - 1``, and the slot retires once
+    ``len(tokens) == max_new_tokens`` — so the highest written position
+    is ``prompt_len + max_new_tokens - 2`` and the fill watermark is
+    ``prompt_len + max_new_tokens - 1``."""
+    return -(-(prompt_len + max_new_tokens - 1) // page_size)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmitPlan:
+    """What :meth:`PageAllocator.admit` decided for one admission:
+    ``shared_tokens`` prompt tokens whose K/V is already resident in
+    mapped shared pages (0 = cold), which doubles as the slot's write
+    floor — prefill K/V writes below it are masked (shared pages are
+    immutable; the masked values would be bit-identical anyway)."""
+
+    shared_tokens: int
+    pages: tuple
+
+
+def _prefix_hashes(tokens) -> list:
+    """Rolling polynomial hash of every prefix: ``out[i]`` covers
+    ``tokens[:i]``. One O(n) pass at admit/registration time; the
+    prefix index is keyed on ``(n_tokens, out[n_tokens])`` and every
+    hit is confirmed with a full token compare before any page is
+    mapped (collision safety is correctness, not probability)."""
+    h = 0
+    out = [0] * (len(tokens) + 1)
+    for i, t in enumerate(tokens):
+        h = (h * 1000003 + int(t) + 1) & 0x7FFFFFFFFFFFFFFF
+        out[i + 1] = h
+    return out
+
+
+@dataclasses.dataclass
+class _PrefixEntry:
+    tokens: tuple  # the exact prefix (full compare before mapping)
+    pages: tuple   # pages covering it, in position order
+
+
+class PageAllocator:
+    """Host-side page bookkeeping for one :class:`PagedKVCache`.
+
+    - **Free-list reuse**: freed pages go back to the pool and are
+      handed out again without zeroing (mask-defined validity).
+    - **Prefix sharing**: once a request's prompt is fully prefilled,
+      its page-aligned prefixes (and the full prompt, partial last page
+      included) are registered in a rolling-hash index. A later admit
+      whose prompt extends a registered prefix maps those pages
+      (refcount++) instead of allocating + recomputing — full token
+      compare before mapping, so a hash collision can never alias two
+      prompts. Entries die with their pages (sharing is between
+      temporally overlapping requests; the index holds no refs).
+    - **Copy-on-write**: shared pages (refcount > 1) are immutable. Any
+      write landing in one first copies it to a private page
+      (:meth:`cow_before_write` returns the (src, dst) pair for the
+      engine's device copy). Only the partially-filled last page of a
+      shared prefix can ever be written while shared, and each mapper
+      of one RESERVES a free page at admit — so a COW can never fail
+      mid-decode; admission is the only capacity gate.
+    - **No partial allocation**: :meth:`admit` checks the whole
+      requirement (fresh pages + COW reserve) before taking anything;
+      an insufficient pool returns ``None`` and the request stays
+      queued.
+    """
+
+    def __init__(self, num_pages: int, page_size: int,
+                 pages_per_slot: int, slots: int):
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.pages_per_slot = pages_per_slot
+        self.slots = slots
+        self.block_tables = np.zeros((slots, pages_per_slot), np.int32)
+        self.reset()
+
+    def reset(self) -> None:
+        self.block_tables[:] = 0
+        self.refcount = np.zeros(self.num_pages, np.int64)
+        self.free: list[int] = list(range(self.num_pages))[::-1]  # pop()=0 first
+        self.reserved = 0  # free pages promised to future COW copies
+        self._cow_reserve: dict[int, int] = {}  # page -> outstanding reserves
+        self._slot_pages: dict[int, list[int]] = {}
+        self._index: dict[tuple[int, int], _PrefixEntry] = {}
+        self._page_keys: dict[int, set] = {}  # page -> index keys citing it
+        # Stats (the scheduler's kv gauges + bench's prefix_hit_rate).
+        self.cow_copies = 0
+        self.prefix_hits = 0
+        self.admissions = 0
+        self.shared_tokens_total = 0
+        self.prompt_tokens_total = 0
+
+    # -- capacity -----------------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        """Pages admittable RIGHT NOW (free minus the COW reserve)."""
+        return len(self.free) - self.reserved
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self.free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.pages_in_use / self.num_pages
+
+    @property
+    def pages_shared(self) -> int:
+        """Pages mapped by more than one slot — each unit here is one
+        page of K/V the dense cache would have stored twice."""
+        return int(np.maximum(self.refcount - 1, 0).sum())
+
+    @property
+    def hit_rate(self) -> float:
+        """Fraction of admitted prompt tokens served from shared pages."""
+        return (
+            self.shared_tokens_total / self.prompt_tokens_total
+            if self.prompt_tokens_total
+            else 0.0
+        )
+
+    def pages_for(self, prompt_len: int, max_new_tokens: int) -> int:
+        return pages_needed(prompt_len, max_new_tokens, self.page_size)
+
+    # -- admission ----------------------------------------------------------
+    def _find_shared_prefix(self, prompt: tuple):
+        """Longest registered prefix of ``prompt``, every length probed
+        descending (O(plen) dict lookups — the index holds page-aligned
+        boundaries plus full prompts, so this finds a partial-page entry
+        even when ``prompt`` EXTENDS the registered prompt: the
+        system-prompt case COW sharing exists for). Returns
+        (n_tokens, entry) or (0, None)."""
+        hashes = _prefix_hashes(prompt)
+        for n in range(len(prompt), 0, -1):
+            entry = self._index.get((n, hashes[n]))
+            if entry is not None and entry.tokens == tuple(prompt[:n]):
+                return n, entry
+        return 0, None
+
+    def admit(self, slot: int, prompt, max_new_tokens: int):
+        """Map pages for one request into ``slot``'s block table.
+
+        Returns an :class:`AdmitPlan`, or ``None`` when the pool cannot
+        hold the request right now (nothing is taken — the caller keeps
+        it queued and retries after a retirement frees pages). Raises
+        only on requests that could NEVER fit (caller bug — validated
+        at submit)."""
+        prompt = tuple(int(t) for t in prompt)
+        need_total = self.pages_for(len(prompt), max_new_tokens)
+        if need_total > self.pages_per_slot:
+            raise ValueError(
+                f"request needs {need_total} pages > pages_per_slot "
+                f"{self.pages_per_slot} (prompt + max_new_tokens exceeds "
+                f"the per-slot max_len)"
+            )
+        if need_total > self.num_pages:
+            raise ValueError(
+                f"request needs {need_total} pages but the pool holds "
+                f"only {self.num_pages} (page_size {self.page_size}); "
+                f"shrink prompt + max_new_tokens or grow num_pages"
+            )
+        shared_tokens, entry = self._find_shared_prefix(prompt)
+        shared_pages = list(entry.pages) if entry is not None else []
+        partial_shared = bool(shared_tokens % self.page_size)
+        own_needed = need_total - len(shared_pages)
+        # The whole requirement up front — fresh pages now, plus one
+        # reserved free page per mapped partial page (its future COW
+        # copy) — or nothing: no partial allocation.
+        if self.free_pages < own_needed + (1 if partial_shared else 0):
+            return None
+        fresh = [self.free.pop() for _ in range(own_needed)]
+        for p in fresh:
+            self.refcount[p] = 1
+        for p in shared_pages:
+            self.refcount[p] += 1
+        if partial_shared:
+            last = shared_pages[-1]
+            self._cow_reserve[last] = self._cow_reserve.get(last, 0) + 1
+            self.reserved += 1
+        mapping = shared_pages + fresh
+        self._slot_pages[slot] = mapping
+        self.block_tables[slot] = 0  # no stale entries from the last tenant
+        self.block_tables[slot, : len(mapping)] = mapping
+        self.admissions += 1
+        if shared_tokens:
+            self.prefix_hits += 1
+        self.shared_tokens_total += shared_tokens
+        self.prompt_tokens_total += len(prompt)
+        return AdmitPlan(shared_tokens=shared_tokens, pages=tuple(mapping))
+
+    def register_prefix(self, slot: int, prompt) -> None:
+        """Index ``slot``'s now-fully-prefilled prompt so later admits
+        can share it: one entry per page-aligned prefix plus the full
+        prompt (covering its partially-filled last page). Call only
+        AFTER the final prefill chunk executed — an entry must never
+        advertise K/V that is not on the device yet."""
+        prompt = tuple(int(t) for t in prompt)
+        mapping = self._slot_pages.get(slot)
+        if mapping is None:
+            return
+        hashes = _prefix_hashes(prompt)
+        ps = self.page_size
+        plen = len(prompt)
+        boundaries = [k * ps for k in range(1, plen // ps + 1)]
+        if plen % ps:
+            boundaries.append(plen)
+        for n in boundaries:
+            key = (n, hashes[n])
+            if key in self._index:
+                continue  # first registration wins; content is identical
+            pages = tuple(mapping[: -(-n // ps)])
+            self._index[key] = _PrefixEntry(
+                tokens=prompt[:n], pages=pages
+            )
+            for p in pages:
+                self._page_keys.setdefault(p, set()).add(key)
+
+    # -- write path ---------------------------------------------------------
+    def cow_before_write(self, slot: int, position: int):
+        """Make the page holding ``position`` privately writable by
+        ``slot``. Returns ``(src, dst)`` when a copy-on-write remap
+        happened (the caller must copy page ``src`` → ``dst`` on the
+        device BEFORE the write executes), else ``None``."""
+        idx = position // self.page_size
+        page = int(self.block_tables[slot, idx])
+        if self.refcount[page] <= 1:
+            return None
+        # Reservation accounting guarantees this pop succeeds: every
+        # mapper of a shared partial page reserved one free page, and
+        # only partial pages are ever written while shared.
+        if not self.free:
+            raise RuntimeError(
+                "COW with an empty free list — reservation accounting bug"
+            )
+        dst = self.free.pop()
+        if self._cow_reserve.get(page, 0) > 0:
+            self._cow_reserve[page] -= 1
+            self.reserved -= 1
+        self.refcount[page] -= 1
+        self.refcount[dst] = 1
+        self._trim_reserve(page)
+        self.block_tables[slot, idx] = dst
+        self._slot_pages[slot][idx] = dst
+        self.cow_copies += 1
+        return page, dst
+
+    def _trim_reserve(self, page: int) -> None:
+        """Release COW reserves a page can no longer need. A page with
+        ``refcount`` mappers needs at most ``refcount - 1`` future
+        copies (the last owner writes in place), so any excess goes
+        back to the admittable pool — including the reserve of a
+        sharer that RETIRED without ever writing (full-prompt prefix
+        hit finishing at prefill): without this, sustained overlapping
+        shared-prefix traffic leaks one reserve per such request until
+        the whole cohort drains, and ``free_pages`` starves admission
+        with a nearly empty pool."""
+        keep = max(int(self.refcount[page]) - 1, 0)
+        excess = self._cow_reserve.get(page, 0) - keep
+        if excess > 0:
+            self._cow_reserve[page] -= excess
+            self.reserved -= excess
+
+    # -- release ------------------------------------------------------------
+    def free_slot(self, slot: int) -> None:
+        """Unmap ``slot``'s pages; pages at refcount 0 return to the
+        free list and any prefix-index entries citing them die (their
+        advertised K/V is about to be recycled)."""
+        for p in self._slot_pages.pop(slot, []):
+            self.refcount[p] -= 1
+            self._trim_reserve(p)
+            if self.refcount[p] == 0:
+                for key in self._page_keys.pop(p, ()):  # invalidate
+                    entry = self._index.pop(key, None)
+                    if entry is not None:
+                        for q in entry.pages:
+                            if q != p and q in self._page_keys:
+                                self._page_keys[q].discard(key)
+                self.free.append(p)

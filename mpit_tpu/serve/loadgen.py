@@ -51,12 +51,22 @@ class RequestClass:
     """One shape class in the traffic mix: uniform prompt/output-length
     ranges (inclusive) drawn per request, weighted against the other
     classes. Names label the request (``Request.rid`` carries the class
-    via the trace; the class itself rides ``Arrival.klass``)."""
+    via the trace; the class itself rides ``Arrival.klass``).
+
+    ``prefix_len`` (ISSUE 7 satellite) prepends a SHARED prefix of that
+    many tokens to every prompt of the class — the system-prompt
+    pattern the paged engine's prefix sharing exists for. One prefix
+    token sequence is drawn per trace (seed-determined) and shared by
+    ALL classes: a class with a shorter ``prefix_len`` uses the first
+    tokens of the longest one, so class prefixes nest. Total prompt
+    length becomes ``prefix_len + draw(prompt_len)``.
+    """
 
     name: str
     weight: float = 1.0
     prompt_len: tuple[int, int] = (4, 16)
     max_new_tokens: tuple[int, int] = (8, 32)
+    prefix_len: int = 0
 
     def __post_init__(self):
         for field, (lo, hi) in (
@@ -72,6 +82,17 @@ class RequestClass:
             raise ValueError(
                 f"class {self.name!r}: weight must be > 0, got {self.weight}"
             )
+        if self.prefix_len < 0:
+            raise ValueError(
+                f"class {self.name!r}: prefix_len must be >= 0, got "
+                f"{self.prefix_len}"
+            )
+
+    @property
+    def max_prompt_total(self) -> int:
+        """Largest total prompt this class can draw (prefix included) —
+        what engine-geometry validation must bound."""
+        return self.prefix_len + self.prompt_len[1]
 
 
 # The default production-ish mix: mostly short interactive turns, a
@@ -186,6 +207,17 @@ def generate_arrivals(
     times = _arrival_times(spec, rng, duration_s, max_requests)
     weights = np.asarray([c.weight for c in spec.classes], np.float64)
     weights /= weights.sum()
+    # ONE shared prefix sequence per trace (drawn only when some class
+    # asks for one, so prefix-free specs keep their historical rng
+    # stream and pinned traces): class k's prefix is its first
+    # ``prefix_len`` tokens — nested prefixes, like tiered system
+    # prompts, and exactly what the paged engine's prefix index shares.
+    max_pref = max((c.prefix_len for c in spec.classes), default=0)
+    prefix_pool = (
+        rng.randint(0, vocab_size, size=max_pref).tolist()
+        if max_pref
+        else []
+    )
     out: list[Arrival] = []
     for i, t in enumerate(times):
         klass = spec.classes[int(rng.choice(len(spec.classes), p=weights))]
@@ -202,7 +234,8 @@ def generate_arrivals(
                 klass=klass.name,
                 request=Request(
                     rid=i,
-                    prompt=rng.randint(0, vocab_size, size=plen).tolist(),
+                    prompt=prefix_pool[: klass.prefix_len]
+                    + rng.randint(0, vocab_size, size=plen).tolist(),
                     max_new_tokens=new,
                     temperature=spec.temperature,
                     top_k=spec.top_k,
@@ -217,7 +250,9 @@ def generate_arrivals(
 # Keys parse_load_spec accepts, with their coercions. Prompt/output
 # overrides collapse the class mix to ONE uniform class — the CLI knob
 # for "just give me N-token prompts"; the full mixture stays
-# programmatic (bench, tests).
+# programmatic (bench, tests). ``prefix`` stamps a shared prefix length
+# onto every class (ISSUE 7 satellite: prefix reuse drivable from the
+# open-loop harness).
 _SPEC_KEYS = {
     "rate": float,
     "process": str,
@@ -229,15 +264,17 @@ _RANGE_KEYS = ("prompt_min", "prompt_max", "new_min", "new_max")
 
 
 def parse_load_spec(text: str) -> LoadSpec:
-    """``"rate=8,process=bursty,on_fraction=0.25,tenants=4"`` →
-    :class:`LoadSpec` (the serve CLI's ``--loadgen`` value).
+    """``"rate=8,process=bursty,on_fraction=0.25,tenants=4,prefix=32"``
+    → :class:`LoadSpec` (the serve CLI's ``--loadgen`` value).
 
     Optional ``prompt_min/prompt_max/new_min/new_max`` replace the
     default interactive/batch mixture with a single uniform class over
-    those ranges.
+    those ranges; ``prefix=N`` gives every class an N-token shared
+    prefix (the trace-wide system prompt).
     """
     kw: dict = {}
     ranges: dict[str, int] = {}
+    prefix = 0
     for part in text.split(","):
         part = part.strip()
         if not part:
@@ -252,10 +289,12 @@ def parse_load_spec(text: str) -> LoadSpec:
             kw[key] = _SPEC_KEYS[key](val)
         elif key in _RANGE_KEYS:
             ranges[key] = int(val)
+        elif key == "prefix":
+            prefix = int(val)
         else:
             raise ValueError(
                 f"unknown --loadgen key {key!r} (valid: "
-                f"{', '.join((*_SPEC_KEYS, *_RANGE_KEYS))})"
+                f"{', '.join((*_SPEC_KEYS, *_RANGE_KEYS, 'prefix'))})"
             )
     if "rate" not in kw:
         raise ValueError("--loadgen needs rate=<req/s>")
@@ -268,5 +307,10 @@ def parse_load_spec(text: str) -> LoadSpec:
                 max_new_tokens=(ranges.get("new_min", 8),
                                 ranges.get("new_max", 32)),
             ),
+        )
+    if prefix:
+        kw["classes"] = tuple(
+            dataclasses.replace(c, prefix_len=prefix)
+            for c in kw.get("classes", DEFAULT_MIX)
         )
     return LoadSpec(**kw)

@@ -48,6 +48,20 @@ ISSUE 6 grows the loop production-shaped:
 - **Bounded intake**: ``Server(max_queue=N)`` sheds arrivals beyond N
   queued (counted in ``serve_shed`` / ``Server.shed`` — the shed-rate
   SLO's numerator); unbounded by default.
+
+ISSUE 7 (paged engine): admission becomes a PAGE grant, not just a slot
+grant — the head of the queue gets a free slot plus its whole page
+requirement (fresh pages + shared-prefix mappings + COW reserve,
+all-or-nothing) or waits; prompts feed the device ``prefill_chunk``
+tokens per tick interleaved with decode (``prefilling`` state — a long
+admit cannot head-of-line-block TTFT for live slots); a finished prompt
+is registered in the allocator's prefix index so later identical
+prefixes map the same pages (refcounted, copy-on-write on divergence —
+the scheduler calls ``cow_before_write`` before every prefill-chunk /
+decode write and runs the device page copy it returns); retirement
+frees the slot's pages back to the pool. ``kv_tokens_cached`` /
+``kv_pool_occupancy`` / ``prefix_pages_shared`` gauges land in the
+Recorder and the stream windows each tick.
 """
 
 from __future__ import annotations
@@ -75,6 +89,11 @@ def warm_engine(engine) -> None:
     warm = Server(engine)
     warm.submit(Request(rid="warm", prompt=[1, 2, 3], max_new_tokens=2))
     warm.run()
+    if getattr(engine, "paged", False):
+        # The COW device copy is its own (tiny) compile — a lone warm
+        # request never diverges from a shared page, so pay it here or
+        # the first real divergence pays it inside the timed window.
+        engine.copy_page(0, 0)
     engine.reset()
 
 
@@ -123,6 +142,23 @@ class _Live:
     submit_t: float
     first_token_t: float = 0.0
     tokens: list = dataclasses.field(default_factory=list)
+    # Paged-engine prefill state (ISSUE 7): ``base`` = prompt tokens
+    # already cached (advanced per chunk), ``floor`` = the shared-prefix
+    # write floor granted at admission (positions below it live in
+    # immutable shared pages).
+    base: int = 0
+    floor: int = 0
+
+    def cache_fill(self) -> int:
+        """Host mirror of the device cache fill for a LIVE slot — THE
+        single fill-accounting path (ISSUE 7 satellite: retirement, the
+        tile-skip counter, COW write positions and the kv gauges all
+        read this; two drifting copies would silently corrupt tile
+        skipping). Prefill cached the prompt; each decode tick appends
+        ONE token; the newest sampled token is NOT yet written — so the
+        fill is ``prompt + generated - 1``, and the next decode append
+        lands exactly here."""
+        return len(self.req.prompt) + len(self.tokens) - 1
 
 
 class Server:
@@ -168,15 +204,25 @@ class Server:
             engine, "decode_attention_mode", "reference"
         )
         self._sampler = getattr(engine, "decode_sampler", "dense")
+        self._paged = bool(getattr(engine, "paged", False))
         self.queue: deque[_Live] = deque()
         self.live: dict[int, _Live] = {}  # slot -> in-flight request
+        # Paged engine: slots whose prompt is still being written, one
+        # prefill_chunk slice per tick (chunked prefill — a 1024-token
+        # admit can't head-of-line-block decode for every live slot).
+        self.prefilling: dict[int, _Live] = {}
         self.free: list[int] = list(range(engine.slots))[::-1]  # pop() = slot 0 first
         self.completed: list[Completed] = []
         self.shed: list[Request] = []
         self.tick = 0
         self.admissions = 0
         self._occupancy_sum = 0.0
+        self._kv_occ_sum = 0.0
+        self._kv_occ_peak = 0.0
+        self._pages_shared_peak = 0
+        self._concurrency_peak = 0
         self._truncated = False  # a run stopped with work still pending
+        self._pool_exhausted = False  # edge-trigger for the obs instant
         # Per-slot sampling-control arrays (host; refreshed on admit/retire).
         s = engine.slots
         self._temp = np.zeros((s,), np.float32)
@@ -215,6 +261,21 @@ class Server:
                 f"({len(req.prompt)} + {req.max_new_tokens}) exceeds the "
                 f"engine's max_len {self.engine.max_len}"
             )
+        if self._paged:
+            # A request the POOL could never hold is a caller bug, like
+            # the max_len checks above — raise at submit, not when the
+            # admit loop discovers it can never stop waiting. (The
+            # per-slot virtual capacity is already covered: prompt +
+            # max_new_tokens <= max_len = pages_per_slot × page_size.)
+            alloc = self.engine.allocator
+            need = alloc.pages_for(len(req.prompt), req.max_new_tokens)
+            if need > alloc.num_pages:
+                raise ValueError(
+                    f"request {req.rid!r}: needs {need} pages of "
+                    f"{alloc.page_size} tokens but the pool holds only "
+                    f"{alloc.num_pages}; shrink prompt + max_new_tokens "
+                    f"or grow Engine(kv_pages=...)"
+                )
         k_cap = getattr(self.engine, "sample_k_cap", None)
         if k_cap is not None and req.top_k > k_cap:
             raise ValueError(
@@ -241,6 +302,138 @@ class Server:
 
     # -- the loop -----------------------------------------------------------
     def _admit(self) -> None:
+        """Move queued requests into free slots and start their
+        prefill: dense = one batched whole-prompt call; paged = map
+        pages and enter the per-tick chunk pipeline."""
+        if self._paged:
+            self._admit_paged()
+        else:
+            self._admit_dense()
+
+    def _admit_paged(self) -> None:
+        """Paged admission (ISSUE 7): FIFO — grant the head of the
+        queue a free slot AND its whole page requirement (fresh pages +
+        shared-prefix mappings + COW reserve, all-or-nothing in the
+        allocator) or stop. Stopping on the first request that doesn't
+        fit keeps admission fair: a stream of small requests cannot
+        starve a big one indefinitely. Admitted requests enter
+        ``prefilling``; :meth:`_prefill_chunk_tick` feeds their prompt
+        ``prefill_chunk`` tokens per tick."""
+        alloc = self.engine.allocator
+        now = time.perf_counter()
+        while self.queue and self.free:
+            live = self.queue[0]
+            slot = self.free[-1]
+            plan = alloc.admit(
+                slot, live.req.prompt, live.req.max_new_tokens
+            )
+            if plan is None:
+                # Pool full RIGHT NOW (nothing was taken) — retry after
+                # a retirement frees pages; the queue keeps its order.
+                # Instant only on the TRANSITION into exhaustion: a
+                # sustained overload would otherwise write one instant
+                # per tick into the Recorder's bounded buffer, evicting
+                # the spans the percentiles and the obs diff gate read.
+                if not self._pool_exhausted:
+                    self._pool_exhausted = True
+                    obs.instant(
+                        "kv_pool_exhausted",
+                        free_pages=alloc.free_pages,
+                        queued=len(self.queue),
+                    )
+                break
+            self.queue.popleft()
+            self.free.pop()
+            self._pool_exhausted = False  # an admit fit: episode over
+            # The write floor is the shared-token count; the forward
+            # re-runs at least the LAST prompt token (its logits seed
+            # the first output token), so the feed base is capped one
+            # below the prompt end even on a full-prompt prefix hit.
+            live.floor = plan.shared_tokens
+            live.base = min(plan.shared_tokens, len(live.req.prompt) - 1)
+            self._temp[slot] = live.req.temperature
+            self._topk[slot] = live.req.top_k
+            obs.span_at(
+                "queue_wait", live.submit_t, now,
+                **self._span_attrs(live.req),
+            )
+            if self.stream is not None:
+                self.stream.observe("queue_wait", now - live.submit_t)
+            self.prefilling[slot] = live
+            self.admissions += 1
+
+    def _prefill_chunk_tick(self) -> None:
+        """Advance every prefilling slot by ONE prompt chunk (one
+        batched call). Slots whose final prompt token rides this chunk
+        sample their first output token, register their prompt in the
+        prefix index (only now — an index entry must never advertise
+        K/V not yet on the device) and go live."""
+        if not self.prefilling:
+            return
+        eng = self.engine
+        alloc = eng.allocator
+        s, w = eng.slots, eng.prefill_chunk
+        tokens = np.zeros((s, w), np.int32)
+        base = np.zeros((s,), np.int32)
+        chunk_lens = np.zeros((s,), np.int32)
+        floor = np.zeros((s,), np.int32)
+        sample_mask = np.zeros((s,), bool)
+        finishing: list[tuple[int, _Live]] = []
+        now = time.perf_counter()
+        for slot, live in self.prefilling.items():
+            p = live.req.prompt
+            n = min(w, len(p) - live.base)
+            # First write of this chunk: at the floor on a partial-page
+            # prefix hit, else at the feed base. A write landing in a
+            # still-shared page copies it out first (device page copy);
+            # the allocator's admission reserve guarantees the free page.
+            first_write = max(live.base, live.floor)
+            if first_write < live.base + n:
+                pair = alloc.cow_before_write(slot, first_write)
+                if pair is not None:
+                    eng.copy_page(*pair)
+                    obs.counter("kv_cow_copies")
+            tokens[slot, :n] = p[live.base : live.base + n]
+            base[slot] = live.base
+            chunk_lens[slot] = n
+            floor[slot] = live.floor
+            if live.base + n == len(p):
+                sample_mask[slot] = True
+                finishing.append((slot, live))
+        with obs.span(
+            "prefill",
+            admitted=len(finishing),
+            chunks=int((chunk_lens > 0).sum()),
+            attention=self._attn_mode,
+            sampler=self._sampler,
+            rids=[live.req.rid for live in self.prefilling.values()],
+        ):
+            first = eng.prefill_paged(
+                tokens, base, chunk_lens, floor, sample_mask,
+                self._temp, self._topk,
+            )
+        t_first = time.perf_counter()
+        if self.sentinel is not None:
+            self.sentinel.observe_phases(self.tick, prefill=t_first - now)
+        for slot in self.prefilling:
+            self.prefilling[slot].base += int(chunk_lens[slot])
+        for slot, live in finishing:
+            del self.prefilling[slot]
+            alloc.register_prefix(slot, live.req.prompt)
+            live.first_token_t = t_first
+            live.tokens = [int(first[slot])]
+            obs.span_at(
+                "request_ttft", live.submit_t, t_first,
+                **self._span_attrs(live.req),
+            )
+            if self.stream is not None:
+                self.stream.observe(
+                    "request_ttft", t_first - live.submit_t
+                )
+            self.live[slot] = live
+            self._maybe_retire(slot, t_first)
+
+    def _admit_dense(self) -> None:
         """Move queued requests into free slots and prefill them (one
         batched call however many were admitted this tick)."""
         if not self.queue or not self.free:
@@ -303,12 +496,10 @@ class Server:
         live = self.live[slot]
         req = live.req
         tok = live.tokens[-1]
-        # Host mirror of the device cache fill: prefill cached the prompt,
-        # each decode tick appends ONE token (the newest sampled token is
-        # not yet written). The next decode would write at this position —
-        # at max_len the slot must retire or it would overrun the buffer.
-        cache_len = len(req.prompt) + len(live.tokens) - 1
-        full = cache_len >= self.engine.max_len
+        # The next decode would write at the fill position — at max_len
+        # the slot must retire or it would overrun the buffer (dense) /
+        # its mapped pages (paged).
+        full = live.cache_fill() >= self.engine.max_len
         done = (
             (req.eos_id is not None and tok == req.eos_id)
             or len(live.tokens) >= req.max_new_tokens
@@ -317,6 +508,12 @@ class Server:
         if not done:
             return
         del self.live[slot]
+        if self._paged:
+            # Unmap the slot's pages: refcounts drop, sole-owner pages
+            # return to the free list (recycled WITHOUT zeroing — the
+            # mask defines validity), prefix-index entries whose pages
+            # died are invalidated.
+            self.engine.allocator.free_slot(slot)
         self.free.append(slot)
         self._temp[slot] = 0.0
         self._topk[slot] = 0
@@ -346,6 +543,19 @@ class Server:
         active = np.zeros((self.engine.slots,), bool)
         for slot in self.live:
             active[slot] = True
+        if self._paged:
+            # This tick appends one K/V row per live slot at its fill
+            # position — a slot whose fill still lands in a SHARED page
+            # (full-prompt prefix reuse of a partial last page) must
+            # copy it out first; later ticks find the page private and
+            # this is a no-op refcount probe.
+            for slot, live in self.live.items():
+                pair = self.engine.allocator.cow_before_write(
+                    slot, live.cache_fill()
+                )
+                if pair is not None:
+                    self.engine.copy_page(*pair)
+                    obs.counter("kv_cow_copies")
         t0 = time.perf_counter()
         with obs.span(
             "decode", active=int(active.sum()), attention=self._attn_mode,
@@ -373,10 +583,7 @@ class Server:
             bk = self.engine.decode_block_k
             total = self.engine.max_len // bk
             lens = np.asarray(
-                [
-                    len(live.req.prompt) + len(live.tokens) - 1
-                    for live in self.live.values()
-                ]
+                [live.cache_fill() for live in self.live.values()]
             )
             visited = num_kv_blocks(lens, 1, self.engine.max_len, bk)
             n_free = self.engine.slots - lens.size
@@ -392,15 +599,53 @@ class Server:
             self.live[slot].tokens.append(int(toks[slot]))
             self._maybe_retire(slot, now)
 
+    def _pending(self) -> bool:
+        """Work outstanding: queued, mid-prefill (paged chunking) or
+        live — the loop-termination and truncation predicate."""
+        return bool(self.queue or self.prefilling or self.live)
+
+    def _kv_gauges(self) -> None:
+        """Cache-memory efficiency gauges (ISSUE 7 satellite):
+        ``kv_tokens_cached`` = tokens actually held device-side (live
+        fills + prefill progress — what a token-proportional cache pays
+        for), plus pool occupancy and shared-page count on the paged
+        engine. Recorder gauges AND the rolling stream windows."""
+        kv_tokens = float(
+            sum(l.cache_fill() for l in self.live.values())
+            + sum(l.base for l in self.prefilling.values())
+        )
+        obs.gauge("kv_tokens_cached", kv_tokens)
+        if self.stream is not None:
+            self.stream.set_gauge("kv_tokens_cached", kv_tokens)
+        if not self._paged:
+            return
+        alloc = self.engine.allocator
+        occ = alloc.occupancy
+        shared = alloc.pages_shared
+        self._kv_occ_sum += occ
+        self._kv_occ_peak = max(self._kv_occ_peak, occ)
+        self._pages_shared_peak = max(self._pages_shared_peak, shared)
+        obs.gauge("kv_pool_occupancy", occ)
+        obs.gauge("prefix_pages_shared", float(shared))
+        if self.stream is not None:
+            self.stream.set_gauge("kv_pool_occupancy", occ)
+            self.stream.set_gauge("prefix_pages_shared", float(shared))
+
     def _run_tick(self) -> None:
-        """One loop iteration: admit, gauges, decode, SLO evaluation."""
+        """One loop iteration: admit, prefill chunk (paged), gauges,
+        decode, SLO evaluation."""
         self._admit()
-        occupancy = len(self.live) / self.engine.slots
+        if self._paged:
+            self._prefill_chunk_tick()
+        busy = len(self.live) + len(self.prefilling)
+        self._concurrency_peak = max(self._concurrency_peak, busy)
+        occupancy = busy / self.engine.slots
         self._occupancy_sum += occupancy
         obs.gauge("slot_occupancy", occupancy)
         if self.stream is not None:
             self.stream.set_gauge("slot_occupancy", occupancy)
             self.stream.set_gauge("queue_depth", float(len(self.queue)))
+        self._kv_gauges()
         if self.live:
             self._decode_tick()
         if self.slo is not None:
@@ -413,9 +658,13 @@ class Server:
         ``max_ticks`` with work still queued/live sets the
         ``truncated`` flag ``stats()`` reports — partial completions
         must not read as a finished run."""
-        while (self.queue or self.live) and self.tick < max_ticks:
+        # Each call is a fresh verdict: a prior max_ticks-capped run
+        # (e.g. a staggered prime before more submits) must not latch
+        # ``truncated`` onto a follow-up run that drains everything.
+        self._truncated = False
+        while self._pending() and self.tick < max_ticks:
             self._run_tick()
-        if self.queue or self.live:
+        if self._pending():
             self._truncated = True
         if self.slo is not None:
             self.slo.finish()
@@ -446,6 +695,7 @@ class Server:
         Requests shed by ``max_queue`` are counted, not raised.
         """
         arrivals = sorted(arrivals, key=lambda a: a.t)
+        self._truncated = False  # fresh verdict, as in :meth:`run`
         t0 = time.perf_counter()
         i = 0
         end_t = math.inf if duration is None else duration
@@ -455,12 +705,12 @@ class Server:
                 self.submit(arrivals[i].request)
                 i += 1
             pending_arrivals = i < len(arrivals) and arrivals[i].t < end_t
-            if now >= end_t and not (drain and (self.queue or self.live)):
+            if now >= end_t and not (drain and self._pending()):
                 break
-            if not pending_arrivals and not (self.queue or self.live):
+            if not pending_arrivals and not self._pending():
                 if now >= end_t or i >= len(arrivals):
                     break  # trace exhausted and everything answered
-            if not (self.queue or self.live):
+            if not self._pending():
                 # Idle: sleep to the next arrival (or the window edge)
                 # instead of spinning the host loop dry.
                 wake = arrivals[i].t if pending_arrivals else end_t
@@ -477,7 +727,7 @@ class Server:
             self._run_tick()
             if on_tick is not None:
                 on_tick(self, time.perf_counter() - t0)
-        if self.queue or self.live:
+        if self._pending():
             self._truncated = True
         if self.slo is not None:
             # One closing evaluation: work admitted/shed after the last
@@ -504,7 +754,24 @@ class Server:
             # work still queued or live is PARTIAL — indistinguishable
             # from finished without this flag (ISSUE 6 satellite).
             "truncated": self._truncated,
+            # Most requests simultaneously resident (live + prefilling)
+            # — the capacity number the paged-vs-dense bench pins.
+            "concurrency_peak": self._concurrency_peak,
         }
+        if self._paged:
+            alloc = self.engine.allocator
+            out.update(
+                kv_page_size=alloc.page_size,
+                kv_pool_pages=alloc.num_pages,
+                kv_pool_occupancy_mean=round(
+                    self._kv_occ_sum / max(self.tick, 1), 4
+                ),
+                kv_pool_occupancy_peak=round(self._kv_occ_peak, 4),
+                prefix_hit_rate=round(alloc.hit_rate, 4),
+                prefix_hits=alloc.prefix_hits,
+                prefix_pages_shared_peak=self._pages_shared_peak,
+                kv_cow_copies=alloc.cow_copies,
+            )
         if self.shed:
             out["requests_shed"] = len(self.shed)
         if done:

@@ -133,6 +133,44 @@ def _realistic_results():
             "decode_tokens_per_sec": 123456.7,
             "decode_attention": "reference",
             "decode_sampler": "blocked",
+            # ISSUE 7: the paged-cache headline triple rides the line;
+            # the full capacity + chunked-prefill A/B blocks are
+            # detail-file-only. Worst-case widths throughout.
+            "kv_page_size": 16,
+            "prefix_hit_rate": 0.9792,
+            "max_concurrent_at_hbm": 128,
+            "paged_capacity": {
+                "hbm_budget_rows": 512,
+                "page_size": 16,
+                "request_shape": {"prefix_len": 16, "tail": 4,
+                                  "max_new": 8, "requests": 48},
+                "dense": {"slots": 4, "max_concurrent": 4,
+                          "decode_tokens_per_sec": 12345.6},
+                "paged": {"slots": 32, "pages": 32,
+                          "max_concurrent": 128,
+                          "decode_tokens_per_sec": 12345.6,
+                          "pool_occupancy_peak": 0.9792,
+                          "prefix_hit_rate": 0.9792,
+                          "pages_shared_peak": 3, "cow_copies": 12},
+                "concurrency_ratio": 8.0,
+            },
+            "chunked_prefill": {
+                "geometry": {"slots": 4, "prefill_len": 256,
+                             "prefill_chunk": 32, "kv_pages": 96,
+                             "kv_page_size": 16, "duration_s": 2.5,
+                             "rate": 14.0},
+                "unchunked": {"completed": 24, "ttft_p50_s": 0.123456,
+                              "ttft_p95_s": 1.234567,
+                              "interactive_ttft_p50_s": 0.123456,
+                              "interactive_ttft_p95_s": 1.234567,
+                              "batch_ttft_p95_s": 1.234567},
+                "chunked": {"completed": 24, "ttft_p50_s": 0.123456,
+                            "ttft_p95_s": 0.734567,
+                            "interactive_ttft_p50_s": 0.023456,
+                            "interactive_ttft_p95_s": 0.234567,
+                            "batch_ttft_p95_s": 1.534567},
+                "interactive_ttft_p95_improvement_pct": 81.0,
+            },
             "reference_decode_tokens_per_sec": 98765.4,
             "serve_tokens_per_sec": 98765.4,
             "latency_p50_s": 1.234567,
@@ -238,6 +276,12 @@ class TestLineBudget:
         # driver line must carry it for both cross-checked workloads.
         assert rec["detail"]["alexnet"]["app_path_overhead_pct"] == -12.34
         assert rec["detail"]["gpt2"]["app_path_overhead_pct"] == -12.34
+        # ...but the alexnet app-path NUMBER is the record's headline
+        # ``value`` verbatim, and gpt2's vs_r1_app_path is derivable
+        # from app_path_tokens_per_sec + vs_r1 — both moved off the
+        # per-workload detail to pay for ISSUE 7's serve triple.
+        assert "app_path_images_per_sec" not in rec["detail"]["alexnet"]
+        assert "vs_r1_app_path" not in rec["detail"]["gpt2"]
         # Bulky blobs must NOT ride the line.
         assert "scaling" not in rec["detail"]["alexnet"]
         assert "drop_rate_per_moe_layer" not in rec["detail"]["gpt2_moe"]
@@ -259,10 +303,18 @@ class TestLineBudget:
         assert serve["decode_attention"] == "reference"
         assert serve["latency_p50_s"] == 1.234567
         assert serve["latency_p95_s"] == 2.345678
+        # ISSUE 7: the paged-cache headline triple rides the line —
+        # max concurrency at the fixed HBM budget, the prefix-hit rate
+        # behind it, and the page size defining both; the full
+        # capacity-sweep and chunked-prefill A/B blocks are detail-only.
+        assert serve["kv_page_size"] == 16
+        assert serve["prefix_hit_rate"] == 0.9792
+        assert serve["max_concurrent_at_hbm"] == 128
         for off_line in ("ttft_p50_s", "ttft_p95_s", "occupancy_mean",
                         "generated_tokens", "serve_tokens_per_sec",
                         "prompt_len", "ticks", "decode_sweep",
-                        "decode_sampler",
+                        "decode_sampler", "paged_capacity",
+                        "chunked_prefill",
                         "reference_decode_tokens_per_sec"):
             assert off_line not in serve
         # The SLO sweep (ISSUE 6): the headline triple — max sustained

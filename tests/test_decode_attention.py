@@ -32,13 +32,21 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import mpit_tpu
-from mpit_tpu.models.gpt2 import cached_attention
+from mpit_tpu.models.gpt2 import (
+    cache_update,
+    cached_attention,
+    paged_cache_update,
+    paged_cached_attention,
+    paged_gather,
+)
 from mpit_tpu.ops import lm_head_sample
 from mpit_tpu.ops.decode_attention import (
     flash_decode_attention,
+    flash_paged_decode_attention,
     num_kv_blocks,
     pick_block_k,
     reference_decode_attention,
+    reference_paged_decode_attention,
 )
 
 
@@ -131,6 +139,171 @@ class TestFlashDecodeParity:
             check_vma=False,
         )
         out = jax.jit(f)(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
+def _paged_setup(B=3, T=1, H=2, D=16, n_pages=12, ps=8, pages_per_slot=4,
+                 seed=0, dtype=jnp.float32):
+    """Random queries + a fully random page pool and a SCRAMBLED block
+    table (non-contiguous, non-monotonic page ids, plus shared pages
+    between slots) — the mapping indirection is the thing under test."""
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D), dtype)
+    kp = jax.random.normal(ks[1], (n_pages, ps, H, D), dtype)
+    vp = jax.random.normal(ks[2], (n_pages, ps, H, D), dtype)
+    rng = np.random.RandomState(seed)
+    bt = rng.randint(0, n_pages, size=(B, pages_per_slot)).astype(np.int32)
+    bt[2] = bt[0]  # slot 2 maps slot 0's pages (prefix sharing shape)
+    return q, kp, vp, jnp.asarray(bt)
+
+
+class TestPagedFlashDecode:
+    """ISSUE 7: the paged kernel vs the gather-dense reference, and the
+    paged write/gather primitives vs the dense cache ops."""
+
+    def test_paged_update_and_gather_match_dense(self):
+        """Writing through a permuted block table then gathering the
+        dense view reproduces the dense cache_update exactly."""
+        rng = np.random.RandomState(0)
+        B, T, H, D, ps = 2, 3, 2, 4, 4
+        bt = jnp.asarray([[3, 1, 6, 0], [2, 5, 7, 4]], jnp.int32)
+        dense = jnp.zeros((B, 16, H, D))
+        pool = jnp.zeros((8, ps, H, D))
+        new = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+        lens = jnp.asarray([2, 13], jnp.int32)
+        d2 = cache_update(dense, new, lens)
+        p2 = paged_cache_update(
+            pool, new, lens, bt, valid=jnp.ones((B, T), bool)
+        )
+        assert jnp.all(paged_gather(p2, bt) == d2)
+
+    def test_masked_rows_are_dropped_not_written(self):
+        """A write-masked row must not land ANYWHERE in the pool — the
+        guarantee that a padded prefill chunk (or a non-admitted slot)
+        can never touch a page another slot owns."""
+        B, T, H, D, ps = 2, 4, 2, 4, 4
+        bt = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+        pool = jnp.full((4, ps, H, D), 7.0)
+        new = jnp.ones((B, T, H, D))
+        valid = jnp.asarray([[True, True, False, False],
+                             [False, False, False, False]])
+        out = paged_cache_update(
+            pool, new, jnp.asarray([0, 0], jnp.int32), bt, valid=valid
+        )
+        assert jnp.all(out[0, :2] == 1.0)  # the two valid rows landed
+        assert jnp.all(out[0, 2:] == 7.0)  # padding dropped
+        assert jnp.all(out[1:] == 7.0)  # slot 1 wrote nothing at all
+
+    def test_positions_past_virtual_capacity_dropped(self):
+        """lengths + T past pages_per_slot×ps must drop, not wrap into
+        the slot's last page."""
+        pool = jnp.zeros((4, 4, 1, 2))
+        bt = jnp.asarray([[0, 1]], jnp.int32)  # capacity 8
+        out = paged_cache_update(
+            pool, jnp.ones((1, 2, 1, 2)), jnp.asarray([7], jnp.int32), bt
+        )
+        assert float(out.sum()) == 2.0  # position 7 landed, 8 dropped
+
+    @pytest.mark.parametrize("block_k", [4, 8, None])
+    def test_kernel_matches_reference_ragged_lengths(self, block_k):
+        q, kp, vp, bt = _paged_setup()
+        lengths = jnp.asarray([0, 13, 31], jnp.int32)
+        ref = reference_paged_decode_attention(q, kp, vp, lengths, bt)
+        out = flash_paged_decode_attention(
+            q, kp, vp, lengths, bt, block_k=block_k, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_kernel_prefill_tail_small_t(self):
+        q, kp, vp, bt = _paged_setup(T=4)
+        lengths = jnp.asarray([0, 9, 21], jnp.int32)
+        ref = reference_paged_decode_attention(q, kp, vp, lengths, bt)
+        out = flash_paged_decode_attention(
+            q, kp, vp, lengths, bt, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_shared_pages_attend_identically(self):
+        """Two slots mapping the SAME pages at the same length produce
+        identical outputs for identical queries — prefix sharing in
+        kernel form."""
+        q, kp, vp, bt = _paged_setup()
+        q = q.at[2].set(q[0])  # same query; bt[2] == bt[0] already
+        lengths = jnp.asarray([13, 5, 13], jnp.int32)
+        out = flash_paged_decode_attention(
+            q, kp, vp, lengths, bt, block_k=4, interpret=True
+        )
+        assert jnp.all(out[0] == out[2])
+
+    def test_paged_matches_dense_through_gather(self):
+        """The paged kernel vs the DENSE kernel on the gathered view:
+        same math, different placement."""
+        q, kp, vp, bt = _paged_setup()
+        lengths = jnp.asarray([3, 17, 30], jnp.int32)
+        dense_out = flash_decode_attention(
+            q, paged_gather(kp, bt), paged_gather(vp, bt), lengths,
+            block_k=8, interpret=True,
+        )
+        paged_out = flash_paged_decode_attention(
+            q, kp, vp, lengths, bt, block_k=8, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(paged_out), np.asarray(dense_out),
+            rtol=2e-5, atol=2e-5,
+        )
+
+    def test_non_tpu_fallback_is_reference_bitwise(self):
+        q, kp, vp, bt = _paged_setup()
+        lengths = jnp.asarray([2, 11, 27], jnp.int32)
+        out = flash_paged_decode_attention(q, kp, vp, lengths, bt)
+        ref = paged_cached_attention(q, kp, vp, lengths, bt)
+        assert jnp.all(out == ref)
+
+    def test_visited_tiles_length_dependent_and_match_host(self):
+        """Tile skipping survives the indirection: the in-kernel bound
+        over the VIRTUAL per-slot cache equals the host formula."""
+        q, kp, vp, bt = _paged_setup()
+        s_virtual = bt.shape[1] * kp.shape[1]  # 32
+        lengths = jnp.asarray([0, 13, 31], jnp.int32)
+        _, visited = flash_paged_decode_attention(
+            q, kp, vp, lengths, bt, block_k=4, interpret=True,
+            return_visited=True,
+        )
+        host = num_kv_blocks(np.asarray(lengths), 1, s_virtual, 4)
+        assert list(np.asarray(visited)) == list(host) == [1, 4, 8]
+
+    def test_block_k_must_divide_page_size(self):
+        """A tile must never straddle pages — validated on every
+        platform, like the dense divisibility check."""
+        q, kp, vp, bt = _paged_setup(ps=8)
+        with pytest.raises(ValueError, match="divisible"):
+            flash_paged_decode_attention(
+                q, kp, vp, jnp.zeros((3,), jnp.int32), bt, block_k=6
+            )
+
+    def test_tp_head_shard_call(self, world_2d):
+        """The paged kernel on an H/P head shard inside shard_map (the
+        TP paged engine's exact call)."""
+        q, kp, vp, bt = _paged_setup(H=4)
+        lengths = jnp.asarray([2, 19, 30], jnp.int32)
+        ref = paged_cached_attention(q, kp, vp, lengths, bt)
+
+        f = world_2d.shard_map(
+            lambda q, kp, vp: flash_paged_decode_attention(
+                q, kp, vp, lengths, bt, interpret=True
+            ),
+            in_specs=(P(None, None, "model"), P(None, None, "model"),
+                      P(None, None, "model")),
+            out_specs=P(None, None, "model"),
+            check_vma=False,
+        )
+        out = jax.jit(f)(q, kp, vp)
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
         )
@@ -372,4 +545,37 @@ class TestDecodeKernelCompiles:
             mk((8 * B, s, h, d), jnp.bfloat16),
             mk((8 * B, s, h, d), jnp.bfloat16),
             mk((8 * B,), jnp.int32),
+        ).compile()
+
+    def test_paged_kernel_compiles_at_serving_shapes(self, v5e_world):
+        """The ISSUE 7 paged variant through the real compiler: SMEM
+        block-table indirection + per-tile DMA source resolution at a
+        production-ish pool geometry."""
+        from mpit_tpu.utils.aot import abstractify
+
+        world = v5e_world
+        h, d, ps, n_pages, per_slot = 12, 64, 64, 2048, 16
+
+        def f(q, kp, vp, lengths, bt):
+            return flash_paged_decode_attention(
+                q, kp, vp, lengths, bt, interpret=False
+            )
+
+        step = jax.jit(
+            world.shard_map(
+                f,
+                in_specs=(P("data"), P(), P(), P("data"), P("data")),
+                out_specs=P("data"),
+            )
+        )
+        B = 8
+        mk = lambda shp, dt, spec: abstractify(
+            jax.ShapeDtypeStruct(shp, dt), world.mesh, spec
+        )
+        step.lower(
+            mk((8 * B, 1, h, d), jnp.bfloat16, P("data")),
+            mk((n_pages, ps, h, d), jnp.bfloat16, P()),
+            mk((n_pages, ps, h, d), jnp.bfloat16, P()),
+            mk((8 * B,), jnp.int32, P("data")),
+            mk((8 * B, per_slot), jnp.int32, P("data")),
         ).compile()

@@ -1,0 +1,267 @@
+"""ISSUE 7: the paged KV pool's host-side bookkeeping, in isolation.
+
+The :class:`~mpit_tpu.serve.kvcache.PageAllocator` is pure host python —
+every capacity/sharing/COW edge case the engine relies on is pinnable
+here without jax (the device-path acceptance — greedy bit-match through
+the paged engine — lives in ``tests/test_serve.py``):
+
+- pool exhaustion at admit is ALL-or-nothing (``None``, no partial
+  allocation) and never-fits requests raise a precise ValueError;
+- freed pages recycle through the free list, and prefix-index entries
+  die with their pages (an entry must never advertise recycled K/V);
+- partial-page prefix mappings reserve a free page per extra mapper
+  (refcount − 1 total), so a copy-on-write can never fail mid-decode —
+  admission is the only capacity gate;
+- a prefix-hash collision can never alias two prompts: every hit is
+  confirmed with a full token compare before any page is mapped.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mpit_tpu.serve.kvcache import (
+    AdmitPlan,
+    PageAllocator,
+    _PrefixEntry,
+    _prefix_hashes,
+    pages_needed,
+)
+
+
+def _alloc(num_pages=16, page_size=4, pages_per_slot=8, slots=4):
+    return PageAllocator(num_pages, page_size, pages_per_slot, slots)
+
+
+class TestPagesNeeded:
+    def test_fill_watermark_math(self):
+        # Highest written position is prompt + new - 2; the watermark
+        # (prompt + new - 1) is what pages must cover.
+        assert pages_needed(1, 1, 4) == 1
+        assert pages_needed(4, 1, 4) == 1  # watermark 4 -> exactly 1 page
+        assert pages_needed(4, 2, 4) == 2
+        assert pages_needed(7, 10, 4) == 4  # watermark 16
+        assert pages_needed(30, 3, 16) == 2
+
+    def test_admit_maps_exactly_pages_needed(self):
+        a = _alloc()
+        plan = a.admit(0, list(range(6)), 4)  # watermark 9 -> 3 pages
+        assert len(plan.pages) == 3
+        assert a.pages_in_use == 3
+        assert plan.shared_tokens == 0
+
+
+class TestCapacity:
+    def test_exhaustion_returns_none_with_no_partial_allocation(self):
+        a = _alloc(num_pages=4, page_size=4)
+        a.admit(0, list(range(8)), 4)  # watermark 11 -> 3 pages
+        free_before = list(a.free)
+        # Needs 2 pages, only 1 free: nothing may be taken.
+        assert a.admit(1, list(range(5)), 3) is None
+        assert a.free == free_before
+        assert a.pages_in_use == 3
+        # A 1-page request still fits.
+        assert a.admit(1, [1, 2], 2) is not None
+
+    def test_never_fits_raises_precise_valueerror(self):
+        a = _alloc(num_pages=4, page_size=4, pages_per_slot=8)
+        with pytest.raises(ValueError, match="pool holds"):
+            a.admit(0, list(range(12)), 8)  # 5 pages > 4-page pool
+        with pytest.raises(ValueError, match="pages_per_slot"):
+            _alloc(num_pages=64, pages_per_slot=2).admit(
+                0, list(range(12)), 8
+            )
+        assert a.pages_in_use == 0  # the raise took nothing either
+
+    def test_freed_pages_recycle_through_free_list(self):
+        a = _alloc(num_pages=4, page_size=4)
+        plan = a.admit(0, list(range(8)), 4)
+        a.free_slot(0)
+        assert a.pages_in_use == 0
+        plan2 = a.admit(1, list(range(4)), 8)  # needs 3 pages again
+        # The recycled pages are handed out again (mask-defined
+        # validity: no zeroing, no quarantine).
+        assert set(plan2.pages) <= set(plan.pages) | set(range(4))
+        assert a.pages_in_use == 3
+
+    def test_admit_clears_stale_block_table_tail(self):
+        a = _alloc()
+        a.admit(0, list(range(20)), 12)  # 8 pages -> fills the row
+        a.free_slot(0)
+        a.admit(0, [1, 2], 2)  # 1 page
+        assert list(a.block_tables[0][1:]) == [0] * 7
+
+
+class TestPrefixSharing:
+    def test_registered_prefix_is_mapped_refcounted(self):
+        a = _alloc()
+        p = list(range(10))
+        plan_a = a.admit(0, p, 4)
+        a.register_prefix(0, p)
+        plan_b = a.admit(1, p + [77, 78], 4)
+        # b shares a's full prompt (10 tokens: 2 full pages + the
+        # partial third) and allocates only its own tail.
+        assert plan_b.shared_tokens == 10
+        assert plan_b.pages[:3] == plan_a.pages[:3]
+        assert all(a.refcount[pg] == 2 for pg in plan_a.pages[:3])
+        assert a.prefix_hits == 1
+        assert a.shared_tokens_total == 10
+        assert a.pages_shared == 3
+        assert 0 < a.hit_rate < 1
+
+    def test_page_aligned_prefix_shares_without_reserve(self):
+        a = _alloc()
+        p = list(range(8))  # exactly 2 pages
+        a.admit(0, p, 4)
+        a.register_prefix(0, p)
+        before = a.free_pages
+        plan = a.admit(1, p + [5], 4)
+        assert plan.shared_tokens == 8
+        # Full-page mappings are immutable forever: no COW reserve.
+        assert a.reserved == 0
+        assert a.free_pages == before - (len(plan.pages) - 2)
+
+    def test_entries_die_with_their_pages(self):
+        a = _alloc()
+        p = list(range(6))
+        a.admit(0, p, 4)
+        a.register_prefix(0, p)
+        a.free_slot(0)  # pages recycled -> the index must forget them
+        plan = a.admit(1, p, 4)
+        assert plan.shared_tokens == 0
+        assert a.prefix_hits == 0
+
+    def test_hash_collision_is_confirmed_by_token_compare(self):
+        """Poison the index with an entry whose KEY matches prompt B's
+        prefix hash but whose tokens differ — the mandatory full-token
+        compare must reject it (collision safety is correctness, not
+        probability)."""
+        a = _alloc()
+        other = tuple(range(100, 104))
+        b = [1, 2, 3, 4, 9]
+        h = _prefix_hashes(b)[4]  # b's real 4-token prefix hash
+        a._index[(4, h)] = _PrefixEntry(tokens=other, pages=(7,))
+        a._page_keys[7] = {(4, h)}
+        a.refcount[7] = 1
+        plan = a.admit(0, b, 2)
+        assert plan.shared_tokens == 0  # hit rejected, cold admit
+        assert a.prefix_hits == 0
+
+    def test_first_registration_wins(self):
+        a = _alloc()
+        p = list(range(4))
+        a.admit(0, p, 4)
+        a.register_prefix(0, p)
+        entry = a._index[(4, _prefix_hashes(p)[4])]
+        a.admit(1, p + [9], 4)
+        a.register_prefix(1, p + [9])
+        # The 4-token boundary entry still cites slot 0's page.
+        assert a._index[(4, _prefix_hashes(p)[4])] is entry
+
+
+class TestCopyOnWrite:
+    def _shared_partial(self):
+        """Slot 0 registered 6 tokens (page_size 4: one full + one
+        partial page); slot 1 maps them and reserves a COW page."""
+        a = _alloc()
+        p = list(range(6))
+        a.admit(0, p, 4)
+        a.register_prefix(0, p)
+        plan = a.admit(1, p + [50, 51], 4)
+        assert plan.shared_tokens == 6
+        return a, plan
+
+    def test_partial_page_mapping_reserves_cow_page(self):
+        a, plan = self._shared_partial()
+        assert a.reserved == 1
+        # The reserve is excluded from admittable capacity but the page
+        # physically stays in the free list (the COW pop source).
+        assert a.free_pages == len(a.free) - 1
+
+    def test_cow_moves_writer_consumes_reserve(self):
+        a, plan = self._shared_partial()
+        partial = plan.pages[1]
+        pair = a.cow_before_write(1, 6)  # slot 1 writes position 6
+        assert pair is not None and pair[0] == partial
+        src, dst = pair
+        assert a.block_tables[1][1] == dst
+        assert a.refcount[src] == 1 and a.refcount[dst] == 1
+        assert a.reserved == 0
+        assert a.cow_copies == 1
+        # Page now private on both sides: further writes are in place.
+        assert a.cow_before_write(1, 7) is None
+        assert a.cow_before_write(0, 6) is None
+
+    def test_sole_owner_write_is_in_place(self):
+        a = _alloc()
+        a.admit(0, list(range(6)), 4)
+        assert a.cow_before_write(0, 6) is None
+        assert a.cow_copies == 0
+
+    def test_release_on_retire_returns_reserve(self):
+        a, plan = self._shared_partial()
+        a.free_slot(1)  # the mapper retires without ever diverging
+        assert a.reserved == 0
+        assert a.pages_shared == 0
+
+    def test_retiring_nonwriter_sharer_releases_its_reserve(self):
+        """A sharer of a partial page that retires WITHOUT ever writing
+        (full-prompt prefix hit finishing at prefill) must give its COW
+        reserve back while the page is still shared by others — a page
+        with refcount mappers needs at most refcount-1 future copies,
+        so holding more starves admission under sustained overlapping
+        shared-prefix traffic."""
+        a = _alloc(num_pages=8, page_size=4, slots=4)
+        p = list(range(6))  # 1 full + 1 partial page
+        a.admit(0, p, 4)
+        a.register_prefix(0, p)
+        a.admit(1, p, 4)  # full-prompt hit: maps both, reserves 1
+        a.admit(2, p, 4)  # second sharer: reserves 1 more
+        assert a.reserved == 2
+        a.free_slot(1)  # retires having never written the partial page
+        assert a.reserved == 1, "non-writing sharer leaked its reserve"
+        # The remaining sharer's divergence still cannot fail...
+        pair = a.cow_before_write(2, 5)
+        assert pair is not None
+        assert a.reserved == 0
+        # ...and the registrant, now sole owner, writes in place.
+        assert a.cow_before_write(0, 5) is None
+
+    def test_cow_cannot_fail_at_pool_exhaustion(self):
+        """Admission reserves the COW page, so a full pool can never
+        strand a shared-page writer: drain the pool to 0 admittable
+        pages, then COW — the reserved page is still there."""
+        a = _alloc(num_pages=5, page_size=4, pages_per_slot=4, slots=5)
+        p = list(range(6))
+        a.admit(0, p, 2)  # 2 fresh pages
+        a.register_prefix(0, p)
+        a.admit(1, p + [9], 2)  # shares both (partial last) + 1 reserve
+        # Drain every admittable page: two one-page requests take the
+        # pool to exactly the COW reserve.
+        assert a.admit(2, [1], 1) is not None
+        assert a.admit(3, [2], 1) is not None
+        assert a.free_pages == 0
+        assert len(a.free) == 1 and a.reserved == 1  # reserve alone left
+        # Nothing more is admittable — the reserve is not for admits.
+        assert a.admit(4, [3], 1) is None
+        pair = a.cow_before_write(1, 6)
+        assert pair is not None  # the reserve made this pop safe
+        assert a.reserved == 0 and len(a.free) == 0
+
+
+class TestAdmitPlanShape:
+    def test_plan_is_frozen_and_ordered(self):
+        a = _alloc()
+        plan = a.admit(0, list(range(5)), 3)
+        assert isinstance(plan, AdmitPlan)
+        # Pages in position order: page i holds tokens [i*ps, (i+1)*ps).
+        assert list(a.block_tables[0][: len(plan.pages)]) == list(plan.pages)
+        with pytest.raises(dataclasses_frozen_error()):
+            plan.shared_tokens = 3
+
+
+def dataclasses_frozen_error():
+    import dataclasses
+
+    return dataclasses.FrozenInstanceError

@@ -544,6 +544,339 @@ class TestServeKernelObservability:
         assert "decode_blocks_skipped" not in summ["counters"]
 
 
+class TestPagedServing:
+    """ISSUE 7 acceptance: greedy decode through the PAGED cache path
+    bit-matches the dense reference engine — staggered multi-request
+    runs (slot AND page reuse), the interpret-mode paged kernel, the TP
+    variant, chunked prefill, prefix sharing and COW divergence all
+    preserve the PR 4 invariant; the allocator's capacity gates surface
+    correctly through the scheduler."""
+
+    def _paged_engine(self, params, **kw):
+        kw.setdefault("slots", 2)
+        kw.setdefault("max_len", 40)
+        kw.setdefault("prefill_len", 8)
+        kw.setdefault("kv_pages", 24)
+        kw.setdefault("kv_page_size", 4)
+        kw.setdefault("decode_attention", "reference")
+        return Engine(CFG, params, **kw)
+
+    def test_staggered_bitmatch_through_paged_reference(
+        self, model_and_params
+    ):
+        """THE acceptance run on the paged pool: admits/retirements
+        interleaved, pages recycled between requests, every greedy
+        output equals its isolated no-cache run."""
+        model, params = model_and_params
+        engine = self._paged_engine(params)
+        server = Server(engine)
+        for i, (p, n) in enumerate(zip(PROMPTS, MAX_NEW)):
+            server.submit(Request(rid=i, prompt=p, max_new_tokens=n))
+        done = server.run()
+        assert len(done) == len(PROMPTS)
+        assert server.admissions == len(PROMPTS) > engine.slots
+        for c in done:
+            assert c.tokens == ref_greedy(
+                model, params, c.prompt, len(c.tokens)
+            ), f"paged request {c.rid} diverged from its isolated run"
+        # Pages actually cycled: the pool never held all six requests
+        # at once, so retirement freed pages that later admits reused.
+        assert engine.allocator.pages_in_use == 0
+
+    def test_staggered_bitmatch_through_paged_kernel(
+        self, model_and_params
+    ):
+        """The same run forced through the Pallas PAGED kernel
+        (interpret mode): block-table-indirected DMA + tile skipping
+        keep the bit-match."""
+        model, params = model_and_params
+        engine = self._paged_engine(
+            params, kv_page_size=8, decode_attention="interpret"
+        )
+        assert engine.decode_attention_mode == "kernel"
+        assert engine.cfg.paged_attention_fn is not None
+        server = Server(engine)
+        for i, (p, n) in enumerate(zip(PROMPTS, MAX_NEW)):
+            server.submit(Request(rid=i, prompt=p, max_new_tokens=n))
+        done = server.run()
+        assert len(done) == len(PROMPTS)
+        for c in done:
+            assert c.tokens == ref_greedy(
+                model, params, c.prompt, len(c.tokens)
+            ), f"request {c.rid} diverged through the paged kernel"
+
+    def test_tp_paged_engine_bitmatch_through_kernel(
+        self, model_and_params
+    ):
+        """data=4 × model=2 fake mesh: the paged pool sharded on heads,
+        block tables replicated, the paged kernel on the H/P shard."""
+        model, params = model_and_params
+        world = mpit_tpu.init({"data": 4, "model": 2}, set_default=False)
+        engine = Engine(
+            CFG, params, slots=2, max_len=40, prefill_len=8,
+            world=world, tp_axis="model",
+            kv_pages=24, kv_page_size=8, decode_attention="interpret",
+        )
+        # [L, P, ps, H, Dh] with H split over the 2-way model axis.
+        shard_shapes = {
+            s.data.shape for s in engine.cache.k.addressable_shards
+        }
+        assert shard_shapes == {
+            (CFG.num_layers, 24, 8, CFG.num_heads // 2, CFG.head_dim)
+        }
+        server = Server(engine)
+        for i, (p, n) in enumerate(zip(PROMPTS[:4], MAX_NEW[:4])):
+            server.submit(Request(rid=i, prompt=p, max_new_tokens=n))
+        done = server.run()
+        assert len(done) == 4
+        for c in done:
+            assert c.tokens == ref_greedy(
+                model, params, c.prompt, len(c.tokens)
+            ), f"TP paged request {c.rid} diverged"
+
+    def test_chunked_prefill_bitmatch_and_interleaves_decode(
+        self, model_and_params
+    ):
+        """prefill_chunk=2: a 6-token admit takes 3 chunk ticks — and
+        decode ticks for the already-live slot run BETWEEN them (the
+        head-of-line-blocking fix), without perturbing either output."""
+        model, params = model_and_params
+        rec = obs.Recorder()
+        with obs.local_recorder(rec):
+            engine = self._paged_engine(params, prefill_chunk=2)
+            server = Server(engine)
+            server.submit(Request(rid="live", prompt=[5], max_new_tokens=10))
+            server.submit(
+                Request(rid="long", prompt=[60, 2, 2, 1, 9, 9],
+                        max_new_tokens=4)
+            )
+            done = {c.rid: c for c in server.run()}
+            summ = rec.summary()
+        for c in done.values():
+            assert c.tokens == ref_greedy(
+                model, params, c.prompt, len(c.tokens)
+            ), f"chunked request {c.rid} diverged"
+        # 3 chunks for "long" + 1 for "live": more prefill spans than
+        # admissions = chunking actually happened...
+        assert summ["phases"]["prefill"]["count"] > server.admissions
+        # ...and "live" kept decoding while "long" was mid-prefill:
+        # max_new=10 needs 9 decode ticks (the first token rides the
+        # prefill), which must all have run despite the 3-tick prefill.
+        assert summ["phases"]["decode"]["count"] >= 9
+
+    def test_prefix_sharing_and_cow_divergence_bitmatch(
+        self, model_and_params
+    ):
+        """Prefix reuse end to end: a later admit maps a live request's
+        registered pages (refcount > 1, pages stored once), a request
+        EXTENDING a shared prompt copies the partial page on divergence
+        (COW), and every output still equals its isolated run."""
+        model, params = model_and_params
+        sysp = [11, 12, 13, 14, 15]
+        engine = self._paged_engine(params, prefill_len=16)
+        server = Server(engine)
+        server.submit(Request(rid="a", prompt=sysp + [20, 21],
+                              max_new_tokens=3))
+        server.submit(Request(rid="b", prompt=sysp + [30],
+                              max_new_tokens=14))  # stays live throughout
+        server.submit(Request(rid="c", prompt=sysp + [20, 21],
+                              max_new_tokens=6))
+        server.submit(Request(rid="d", prompt=sysp + [30, 31, 32, 33],
+                              max_new_tokens=4))  # extends b's prompt
+        done = {c.rid: c for c in server.run()}
+        alloc = engine.allocator
+        assert alloc.prefix_hits >= 1, "no admit ever mapped shared pages"
+        assert alloc.cow_copies >= 1, (
+            "divergence on the shared partial page never copied"
+        )
+        assert alloc.shared_tokens_total >= 6
+        for rid, c in done.items():
+            assert c.tokens == ref_greedy(
+                model, params, c.prompt, len(c.tokens)
+            ), f"request {rid} diverged under prefix sharing/COW"
+
+    def test_full_prompt_reuse_cow_at_decode(self, model_and_params):
+        """Two IDENTICAL prompts overlapping in time: the second maps
+        every page including the partial one (shared_tokens == plen),
+        prefill re-runs only the last prompt token with its write
+        masked, and the first decode append into the still-shared
+        partial page triggers the COW — outputs identical and
+        bit-matching the oracle."""
+        model, params = model_and_params
+        engine = self._paged_engine(params, prefill_len=16)
+        server = Server(engine)
+        p = [11, 12, 13, 14, 15, 16]  # 6 tokens: 1 full + 1 partial page
+        server.submit(Request(rid="a", prompt=p, max_new_tokens=12))
+        # Two ticks first: sharing needs a REGISTERED prefix, and
+        # registration happens when a's prefill completes — a same-tick
+        # co-admission is cold by design.
+        server.run(max_ticks=2)
+        server.submit(Request(rid="b", prompt=p, max_new_tokens=5))
+        done = {c.rid: c for c in server.run()}
+        alloc = engine.allocator
+        assert alloc.shared_tokens_total == len(p)
+        assert alloc.cow_copies >= 1
+        want = ref_greedy(model, params, p, 12)
+        assert done["a"].tokens == want
+        assert done["b"].tokens == want[:5]
+
+    def test_freed_page_reuse_isolation(self, model_and_params):
+        """A retired request's recycled pages (handed out WITHOUT
+        zeroing) must not leak into a new occupant: the same probe
+        request bit-matches before and after unrelated churn through
+        every page."""
+        model, params = model_and_params
+        engine = self._paged_engine(
+            params, slots=1, kv_pages=6, kv_page_size=4, max_len=24
+        )
+        server = Server(engine)
+        server.submit(Request(rid="a", prompt=[9, 9], max_new_tokens=4))
+        server.submit(Request(rid="mid", prompt=[1, 2, 3, 4, 5, 6, 7],
+                              max_new_tokens=12))
+        server.submit(Request(rid="b", prompt=[9, 9], max_new_tokens=4))
+        done = {c.rid: c.tokens for c in server.run()}
+        assert done["a"] == done["b"]
+        assert done["a"] == ref_greedy(model, params, [9, 9], 4)
+
+    def test_pool_exhaustion_queues_then_completes(self, model_and_params):
+        """More slots than pages can serve at once: admission stops at
+        the pool (all-or-nothing), the overflow request WAITS (not an
+        error), and completes bit-exact once retirements free pages."""
+        model, params = model_and_params
+        engine = self._paged_engine(
+            params, slots=4, kv_pages=6, kv_page_size=4
+        )
+        rec = obs.Recorder()
+        with obs.local_recorder(rec):
+            server = Server(engine)
+            for i in range(5):
+                server.submit(
+                    Request(rid=i, prompt=[1 + i, 2, 3], max_new_tokens=6)
+                )
+            done = server.run()
+        assert len(done) == 5
+        # The pool (6 pages, 2 per request) capped concurrency at 3 of
+        # 4 slots — admission waited on pages, not slots.
+        assert server.stats()["concurrency_peak"] == 3
+        assert rec.summary()["instants"]["kv_pool_exhausted"] >= 1
+        for c in done:
+            assert c.tokens == ref_greedy(
+                model, params, c.prompt, len(c.tokens)
+            )
+
+    def test_submit_rejects_never_fitting_request(self, model_and_params):
+        _, params = model_and_params
+        engine = self._paged_engine(
+            params, kv_pages=4, kv_page_size=4, max_len=40, prefill_len=20
+        )
+        server = Server(engine)
+        with pytest.raises(ValueError, match="pool holds only"):
+            server.submit(
+                Request(rid=0, prompt=[1] * 12, max_new_tokens=8)
+            )
+
+    def test_engine_validation(self, model_and_params):
+        _, params = model_and_params
+        with pytest.raises(ValueError, match="kv_page_size"):
+            Engine(CFG, params, slots=1, max_len=40, prefill_len=8,
+                   kv_pages=8, kv_page_size=7)
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            Engine(CFG, params, slots=1, max_len=40, prefill_len=8,
+                   prefill_chunk=4)  # chunking is a paged-engine knob
+        with pytest.raises(ValueError, match="prefill_paged"):
+            self._paged_engine(params).prefill(
+                np.zeros((2, 8), np.int32), np.ones((2,), np.int32),
+                np.ones((2,), bool), np.zeros((2,), np.float32),
+                np.zeros((2,), np.int32),
+            )
+
+    def test_paged_decode_step_never_materializes_logits(
+        self, model_and_params
+    ):
+        """The ISSUE 5 jaxpr pin survives paging: blocked head + paged
+        kernel decode step has no [slots, vocab] f32 and no dense
+        [slots, H, 1, max_len] score tensor."""
+        _, params = model_and_params
+        from tests.test_decode_attention import _avals_with_shape
+
+        slots = 2
+        # sample_block/k_cap forced below the tiny test vocab so the
+        # pin tests the BLOCKED shape (as in the dense-step pin).
+        eng2 = Engine(
+            CFG, params, slots=slots, max_len=40, prefill_len=8,
+            kv_pages=24, kv_page_size=8, decode_attention="interpret",
+            sample_block=32, sample_k_cap=16,
+        )
+        bt = jnp.zeros((slots, eng2.pages_per_slot), jnp.int32)
+        jx = jax.make_jaxpr(eng2._paged_decode_step)(
+            eng2.params, eng2.cache, eng2.last_token,
+            jnp.ones((slots,), bool), bt, jax.random.key(0),
+            jnp.zeros((slots,), jnp.float32), jnp.zeros((slots,), jnp.int32),
+        )
+        for shape in (
+            (slots, CFG.vocab_size),
+            (slots, 1, CFG.vocab_size),
+            (slots, CFG.num_heads, 1, eng2.max_len),
+        ):
+            hits = _avals_with_shape(jx.jaxpr, shape)
+            assert not hits, f"paged decode step materializes {shape}"
+
+    def test_kv_gauges_and_stats(self, model_and_params):
+        """ISSUE 7 satellite: kv_tokens_cached / kv_pool_occupancy /
+        prefix_pages_shared land in the Recorder, the stream registry
+        AND Server.stats()."""
+        _, params = model_and_params
+        from mpit_tpu.obs.stream import StreamRegistry
+
+        rec = obs.Recorder()
+        with obs.local_recorder(rec):
+            engine = self._paged_engine(params, prefill_len=16)
+            reg = StreamRegistry()
+            server = Server(engine, stream=reg)
+            p = [11, 12, 13, 14, 15, 16]
+            server.submit(Request(rid=0, prompt=p, max_new_tokens=10))
+            server.run(max_ticks=2)  # register rid 0's prefix first
+            server.submit(Request(rid=1, prompt=p, max_new_tokens=4))
+            server.run()
+        for g in ("kv_tokens_cached", "kv_pool_occupancy",
+                  "prefix_pages_shared"):
+            assert (g, ()) in rec.gauges, f"{g} missing from the Recorder"
+            assert reg.gauge(g) is not None, f"{g} missing from the stream"
+        stats = server.stats()
+        assert stats["kv_page_size"] == 4
+        assert stats["kv_pool_pages"] == 24
+        assert 0 < stats["kv_pool_occupancy_peak"] <= 1
+        assert 0 < stats["kv_pool_occupancy_mean"] <= 1
+        assert stats["prefix_hit_rate"] > 0
+        assert stats["prefix_pages_shared_peak"] >= 1
+        assert stats["kv_cow_copies"] >= 1
+        assert stats["concurrency_peak"] == 2
+        # The dense engine reports the shared gauges but no pool block.
+        engine_d = Engine(CFG, params, slots=2, max_len=40, prefill_len=8)
+        server_d = Server(engine_d)
+        server_d.submit(Request(rid=0, prompt=[5], max_new_tokens=2))
+        server_d.run()
+        sd = server_d.stats()
+        assert "kv_page_size" not in sd
+        assert sd["concurrency_peak"] == 1
+
+    def test_cli_paged_smoke(self):
+        from mpit_tpu.serve.__main__ import main
+
+        out = main(
+            [
+                "--requests", "4", "--slots", "2", "--max-len", "48",
+                "--prefill-len", "8", "--max-new-tokens", "4",
+                "--kv-pages", "16", "--kv-page-size", "8",
+                "--prefill-chunk", "4",
+            ]
+        )
+        assert out["requests_completed"] == 4
+        assert out["kv_page_size"] == 8
+        assert out["kv_pool_pages"] == 16
+        assert out["decode_tokens_per_sec"] > 0
+
+
 class TestServeCLI:
     def test_cli_smoke_random_init(self):
         from mpit_tpu.serve.__main__ import main
@@ -722,6 +1055,69 @@ class TestLoadGen:
         assert spec.rate == 8.0 and spec.process == "bursty"
         assert spec.on_fraction == 0.5 and spec.tenants == 4
         assert spec.classes == loadgen_default_mix()
+
+    def test_shared_prefix_is_deterministic_and_shared(self):
+        """ISSUE 7 satellite: prefix reuse drivable from the open-loop
+        harness — every request of a prefix class starts with THE SAME
+        seed-determined tokens; shorter class prefixes nest inside the
+        longest (tiered system prompts); determinism pinned."""
+        mix = (
+            RequestClass("chat", weight=0.5, prompt_len=(2, 5),
+                         max_new_tokens=(2, 4), prefix_len=8),
+            RequestClass("tool", weight=0.5, prompt_len=(2, 5),
+                         max_new_tokens=(2, 4), prefix_len=4),
+        )
+        spec = LoadSpec(rate=40.0, classes=mix)
+        a = generate_arrivals(spec, vocab_size=64, duration_s=4.0, seed=9)
+        b = generate_arrivals(spec, vocab_size=64, duration_s=4.0, seed=9)
+        assert _trace_key(a) == _trace_key(b)
+        by_class = {}
+        for arr in a:
+            n = {"chat": 8, "tool": 4}[arr.klass]
+            by_class.setdefault(arr.klass, set()).add(
+                tuple(arr.request.prompt[:n])
+            )
+            # Total length = prefix + drawn body.
+            assert n + 2 <= len(arr.request.prompt) <= n + 5
+        assert len(by_class["chat"]) == 1, "chat prefix not shared"
+        assert len(by_class["tool"]) == 1, "tool prefix not shared"
+        (chat_p,) = by_class["chat"]
+        (tool_p,) = by_class["tool"]
+        assert chat_p[:4] == tool_p, "class prefixes must nest"
+        # A different seed draws a different prefix.
+        c = generate_arrivals(spec, vocab_size=64, duration_s=4.0, seed=10)
+        assert tuple(c[0].request.prompt[:4]) != tool_p or _trace_key(
+            c
+        ) != _trace_key(a)
+
+    def test_prefix_free_spec_trace_unchanged(self):
+        """prefix_len=0 consumes no rng — historical traces (and every
+        pinned determinism test) are byte-identical to pre-ISSUE-7."""
+        spec = LoadSpec(rate=25.0, classes=TEST_MIX)
+        a = generate_arrivals(spec, vocab_size=64, duration_s=2.0, seed=3)
+        with_zero = tuple(
+            RequestClass(c.name, weight=c.weight, prompt_len=c.prompt_len,
+                         max_new_tokens=c.max_new_tokens, prefix_len=0)
+            for c in TEST_MIX
+        )
+        b = generate_arrivals(
+            LoadSpec(rate=25.0, classes=with_zero), vocab_size=64,
+            duration_s=2.0, seed=3,
+        )
+        assert _trace_key(a) == _trace_key(b)
+
+    def test_parse_load_spec_prefix(self):
+        spec = parse_load_spec("rate=4,prefix=16")
+        assert all(c.prefix_len == 16 for c in spec.classes)
+        assert [c.name for c in spec.classes] == [
+            c.name for c in loadgen_default_mix()
+        ]
+        spec2 = parse_load_spec("rate=4,prompt_min=2,prompt_max=6,prefix=8")
+        (klass,) = spec2.classes
+        assert klass.prefix_len == 8
+        assert klass.max_prompt_total == 8 + 6
+        with pytest.raises(ValueError, match="prefix_len"):
+            RequestClass("x", prefix_len=-1)
 
     def test_parse_load_spec_range_override(self):
         spec = parse_load_spec("rate=2,prompt_min=3,prompt_max=5,new_min=2,"
