@@ -55,6 +55,19 @@ and percentiles silently cover a truncated prefix — which
   the trace, breaches fed to the Sentinel, time-in-breach and
   time-to-detect in the roll-up.
 
+ISSUE 8 adds the UTILIZATION layer (:mod:`~mpit_tpu.obs.roofline`):
+jitted executables register their ``cost_analysis()`` FLOPs/bytes once
+at compile, span closes accumulate achieved work (length-aware for the
+tile-skipping flash-decode kernel), and ``summary()`` reports per-phase
+``mfu_pct`` / ``hbm_util_pct`` / ``ici_util_pct`` against the ChipSpec
+roofline peaks — percentages only on the real chip, platform-labeled
+modeled cost everywhere else. Compile observability rides along:
+``compile`` spans + counters at every detected lower/compile
+(``CompileWatch``), a pinned engine-lifetime compile count, and
+sentinel rules for unexpected recompiles and sustained utilization
+collapse (``UtilizationWatch``); ``obs diff`` gates on utilization keys
+and refuses comparisons whose baseline phases disappeared.
+
 Instrumented call sites: ``train.loop.hardened_loop`` (prefetch-wait /
 step / host-fence / eval / checkpoint / divergence-restore phases),
 ``comm.collectives`` (per-op modeled wire bytes — recorded at *trace*
@@ -68,7 +81,7 @@ fast path costs a module-global check and the package can be imported
 from anywhere in the stack without cycles.
 """
 
-from mpit_tpu.obs import aggregate, baseline, slo, stream
+from mpit_tpu.obs import aggregate, baseline, roofline, slo, stream
 from mpit_tpu.obs.core import (
     Recorder,
     counter,
@@ -114,6 +127,7 @@ __all__ = [
     "get_recorder",
     "instant",
     "local_recorder",
+    "roofline",
     "slo",
     "snapshot_trace_events",
     "span",

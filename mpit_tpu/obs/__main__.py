@@ -25,7 +25,9 @@ Usage::
 Exit status: 0 on success; trace mode exits 2 when the file holds no
 span events (a truncated or foreign trace — don't let an empty gap
 report read as "no overhead"); diff mode exits 1 on regressions beyond
-tolerance and 2 on unusable input.
+tolerance (phase-time growth OR a utilization drop, ISSUE 8) and 2 on
+unusable input — malformed files, truncated event buffers, or a
+baseline phase missing from the current snapshot.
 """
 
 from __future__ import annotations
@@ -128,6 +130,20 @@ def _main_diff(argv) -> int:
         }))
         return 2
     verdict = baseline.diff(base, cur, tolerance_pct=args.tolerance_pct)
+    if verdict["missing_phases"]:
+        # A phase present in the baseline but absent from the current
+        # snapshot makes the comparison unusable, not clean (ISSUE 8
+        # satellite): only the intersection was compared, and the phase
+        # that silently disappeared is exactly the one a gate must not
+        # ignore. Same exit as truncated snapshots. (NEW phases are
+        # fine — instrumentation growing is not a broken comparison.)
+        print(json.dumps({
+            "error": "baseline phase(s) missing from the current "
+            "snapshot — the comparison covers only the intersection "
+            "and cannot gate; re-record or prune the baseline",
+            "missing_phases": verdict["missing_phases"],
+        }))
+        return 2
     print(json.dumps(verdict, indent=1))
     return 0 if verdict["ok"] else 1
 

@@ -60,6 +60,18 @@ def snapshot(
         # by count: a load workload's snapshot must record that its SLO
         # tripped, not just its phase times (ISSUE 6).
         out["instants"] = dict(summary["instants"])
+    if summary.get("roofline"):
+        # Per-phase utilization (ISSUE 8): mfu/hbm/ici percentages where
+        # the run was on-chip, modeled cost + platform label otherwise —
+        # diff() gates on the percentage keys.
+        out["roofline"] = {
+            "phases": {
+                name: dict(entry)
+                for name, entry in summary["roofline"]
+                .get("phases", {})
+                .items()
+            }
+        }
     if summary.get("dropped_events"):
         # The snapshot's percentiles describe a TRUNCATED buffer — carry
         # the fact so `obs diff` can refuse to gate on it (exit 2).
@@ -128,8 +140,18 @@ def diff(
     A phase REGRESSES when its current p50 exceeds the baseline p50 by
     more than ``tolerance_pct``. Improvements and total_s drift are
     reported, not gated. Phases only in one snapshot land in
-    ``missing_phases`` / ``new_phases`` (reported, not gated — a renamed
-    phase should fail review, not the gate).
+    ``missing_phases`` / ``new_phases`` — reported here; the CLI treats
+    a non-empty ``missing_phases`` as UNUSABLE input (exit 2, ISSUE 8
+    satellite): a comparison where a baseline phase silently
+    disappeared says nothing about the phases that remain.
+
+    Utilization gating (ISSUE 8): when both snapshots carry a
+    ``roofline`` section, a phase whose ``mfu_pct`` / ``hbm_util_pct``
+    / ``ici_util_pct`` DROPPED by more than ``tolerance_pct`` (relative)
+    is a regression too — time can hold steady while the work done in
+    it collapses. Only numeric-on-both-sides keys are compared, so
+    platform-labeled off-chip snapshots (which record no percentages)
+    never gate vacuously.
     """
     bp = base.get("phases", {})
     cp = cur.get("phases", {})
@@ -155,12 +177,41 @@ def diff(
         if entry["regressed"]:
             regressions.append(name)
         phases[name] = entry
+    # Utilization keys (roofline section, when both sides carry one):
+    # regression = a RELATIVE drop beyond tolerance. Directionality is
+    # inverted vs phase times — higher utilization is better.
+    util: dict[str, dict] = {}
+    util_regressions: list[str] = []
+    br = base.get("roofline", {}).get("phases", {})
+    cr = cur.get("roofline", {}).get("phases", {})
+    from mpit_tpu.obs.roofline import UTIL_KEYS
+
+    for name in sorted(set(br) & set(cr)):
+        for key in UTIL_KEYS:
+            b, c = br[name].get(key), cr[name].get(key)
+            if not isinstance(b, (int, float)) or not isinstance(
+                c, (int, float)
+            ) or b <= 0:
+                continue
+            drop = 100.0 * (b - c) / b
+            entry = {
+                "base": round(float(b), 2),
+                "cur": round(float(c), 2),
+                "drop_pct": round(drop, 2),
+                "regressed": bool(drop > tolerance_pct),
+            }
+            util[f"{name}.{key}"] = entry
+            if entry["regressed"]:
+                util_regressions.append(f"{name}.{key}")
     out = {
         "tolerance_pct": tolerance_pct,
         "phases": phases,
         "missing_phases": sorted(set(bp) - set(cp)),
         "new_phases": sorted(set(cp) - set(bp)),
         "regressions": regressions,
-        "ok": not regressions,
+        "ok": not regressions and not util_regressions,
     }
+    if util:
+        out["utilization"] = util
+        out["util_regressions"] = util_regressions
     return out

@@ -34,8 +34,6 @@ import threading
 import time
 from typing import Any, Iterator, Mapping
 
-import numpy as np
-
 __all__ = [
     "Recorder",
     "counter",
@@ -65,6 +63,11 @@ def phase_stats(durations: Mapping[str, Any]) -> dict:
     duration lists — the ONE definition of the phase roll-up, shared by
     :meth:`Recorder.summary` (live) and ``python -m mpit_tpu.obs``
     (offline traces), so the two reports cannot drift."""
+    # Lazy: keeps this module numpy-free at import, so the pure-host
+    # layers built on it (obs.slo, obs.stream consumers) stay cheap to
+    # import (pinned by tests/test_import_hygiene.py).
+    import numpy as np
+
     phases = {}
     for name, durs in sorted(durations.items()):
         arr = np.asarray(durs)
@@ -95,6 +98,13 @@ class Recorder:
         self.counters: dict[tuple[str, tuple], float] = {}
         self.gauges: dict[tuple[str, tuple], float] = {}
         self._thread_names: dict[int, str] = {}
+        # Roofline accounting (ISSUE 8; obs/roofline.py): per-phase
+        # registered modeled cost (one dict per phase, set at compile)
+        # and accumulated explicit achieved work. Plain floats only —
+        # the roll-up math lives in obs.roofline, imported lazily by
+        # summary() so this module stays import-light.
+        self.costs: dict[str, dict] = {}
+        self.work: dict[str, dict] = {}
 
     # -- recording (called via the module-level primitives) -----------------
     def add_span(
@@ -135,6 +145,39 @@ class Recorder:
         with self._lock:
             self.gauges[(name, _attr_key(attrs))] = float(value)
 
+    def add_cost(self, phase: str, cost: Mapping[str, Any]) -> None:
+        """Register a phase's per-execution modeled cost (last write
+        wins — re-registration after a recompile is legitimate)."""
+        with self._lock:
+            self.costs[phase] = dict(cost)
+
+    def add_work(
+        self,
+        phase: str,
+        *,
+        flops: float | None = None,
+        hbm_bytes: float | None = None,
+        ici_bytes: float | None = None,
+        n: int = 1,
+    ) -> None:
+        """Accumulate explicit achieved work for a phase; a component
+        ever fed here is marked ``explicit`` and the roll-up uses its
+        sum instead of span-count × per-exec modeled cost."""
+        with self._lock:
+            w = self.work.setdefault(
+                phase,
+                {"flops": 0.0, "hbm_bytes": 0.0, "ici_bytes": 0.0,
+                 "n": 0, "explicit": set()},
+            )
+            w["n"] += n
+            for key, value in (
+                ("flops", flops), ("hbm_bytes", hbm_bytes),
+                ("ici_bytes", ici_bytes),
+            ):
+                if value is not None:
+                    w[key] += float(value)
+                    w["explicit"].add(key)
+
     # -- reading ------------------------------------------------------------
     def snapshot(self) -> dict:
         """Consistent copy of all buffers (for exporters)."""
@@ -145,6 +188,11 @@ class Recorder:
                 "gauges": dict(self.gauges),
                 "thread_names": dict(self._thread_names),
                 "dropped": self.dropped,
+                "costs": {k: dict(v) for k, v in self.costs.items()},
+                "work": {
+                    k: {**v, "explicit": set(v["explicit"])}
+                    for k, v in self.work.items()
+                },
             }
 
     def counter_items(self, name: str) -> Iterator[tuple[dict, float]]:
@@ -171,11 +219,15 @@ class Recorder:
                 "gauges": self.gauges,
                 "thread_names": dict(self._thread_names),
                 "dropped": self.dropped,
+                "costs": self.costs,
+                "work": self.work,
             }
             self.events = []
             self.counters = {}
             self.gauges = {}
             self.dropped = 0
+            self.costs = {}
+            self.work = {}
         return out
 
     def event_count(self) -> int:
@@ -200,6 +252,12 @@ class Recorder:
         by_name: dict[str, list[float]] = {}
         labels: dict[str, dict[str, set]] = {}
         instants: dict[str, int] = {}
+        # Compile-overlay seconds per TRIGGERING phase (the `compile`
+        # span's `phase` attr, obs.roofline.CompileWatch): the roofline
+        # roll-up excludes them from its utilization denominator — a
+        # phase's first call absorbs trace+compile wall that is not
+        # steady-state execution.
+        compile_s: dict[str, float] = {}
         for kind, name, _t0, dur, _tid, attrs in snap["events"][since:]:
             if kind == "i":
                 # Instants (anomaly, slo_breach, slo_recovered, ...) are
@@ -210,6 +268,9 @@ class Recorder:
                 instants[name] = instants.get(name, 0) + 1
             if kind == "X":
                 by_name.setdefault(name, []).append(dur)
+                if name == "compile" and attrs and "phase" in attrs:
+                    ph = attrs["phase"]
+                    compile_s[ph] = compile_s.get(ph, 0.0) + dur
                 # String-valued span attrs are mode LABELS (e.g. the
                 # serve path's attention="kernel"|"reference") — roll
                 # the distinct values up so a report reader can see
@@ -241,6 +302,22 @@ class Recorder:
             counters[name] = counters.get(name, 0.0) + v
         out = {"phases": phases, "collectives": collectives,
                "counters": counters}
+        if snap["costs"] and since == 0:
+            # Roofline roll-up (ISSUE 8): achieved work vs measured
+            # span seconds against chip peaks, for every phase whose
+            # executable registered its cost; compile-overlay seconds
+            # are excluded from the denominator. Lazy import — the math
+            # (and its honesty rules) lives in obs.roofline. Only on
+            # UNSCOPED summaries: work/cost accumulation is cumulative
+            # (not event-indexed), so a `since` slice would divide
+            # whole-recording work by a window's seconds and report
+            # inflated utilization.
+            from mpit_tpu.obs import roofline as _roofline
+
+            out["roofline"] = _roofline.rollup(
+                snap["costs"], snap["work"], phases,
+                overlay_seconds=compile_s,
+            )
         if instants:
             out["instants"] = dict(sorted(instants.items()))
         # ALWAYS present (ISSUE 6 satellite): a consumer deciding
@@ -445,6 +522,13 @@ _HOST_PHASES = (
     "divergence_restore",
 )
 _OVERLAPPED_PHASES = ("prefetch_host", "prefetch_device_put")
+# Overlay phases NEST inside another phase's span rather than adding
+# wall time of their own: a ``compile`` span (obs.roofline.CompileWatch)
+# covers the same interval as the step/prefill/decode span whose first
+# call triggered the compile. Wall-time reconciliations that sum
+# sequential loop phases must exclude these, exactly like the
+# pipeline-thread overlapped phases above.
+_OVERLAY_PHASES = ("compile",)
 
 
 def gap_attribution(summ: Mapping | None = None) -> dict:

@@ -63,9 +63,11 @@ from mpit_tpu.models.gpt2 import (
     paged_cache_update,
     paged_cached_attention,
 )
+from mpit_tpu.obs import roofline as _roofline
 from mpit_tpu.ops.decode_attention import (
     flash_decode_attention,
     flash_paged_decode_attention,
+    num_kv_blocks,
     pick_block_k,
 )
 from mpit_tpu.ops.lm_head import lm_head_sample
@@ -387,6 +389,11 @@ class Engine:
                 )
         self._sample_block = sample_block
         platform = jax.devices()[0].platform
+        # Where this engine's measurements are recorded — the label that
+        # gates utilization verdicts (ISSUE 8): modeled costs are
+        # recorded on any platform; MFU/bandwidth percentages only when
+        # the recording platform IS the chip.
+        self.platform = platform
         if decode_attention == "reference":
             attn_fn = None  # cached_attention — the PR 4 path verbatim
             self.decode_attention_mode = "reference"
@@ -529,6 +536,31 @@ class Engine:
             self._decode_jit = jax.jit(self._decode_step)
         self.last_token = jnp.zeros((slots,), jnp.int32)
         self._forward = fwd
+        # Engine-lifetime compile accounting (ISSUE 8): the "two
+        # compiles (dense) / three (paged: + copy_page), zero
+        # per-request recompiles" claim as a runtime-guarded metric.
+        # Every jitted-step invocation below routes through the watch;
+        # growth past `expected` is an unexpected recompile (instant +
+        # sentinel note — the Server attaches its sentinel).
+        self.compile_watch = _roofline.CompileWatch(
+            expected=3 if self.paged else 2, scope="engine"
+        )
+        # Per-execution modeled costs (set by register_roofline).
+        self.roofline_costs: dict | None = None
+        self._param_bytes = float(
+            sum(
+                l.size * l.dtype.itemsize
+                for l in jax.tree.leaves(params)
+                if hasattr(l, "dtype")
+            )
+        )
+        # One cached K (or V) row of one layer, in the cache dtype —
+        # the unit of the length-aware decode-bytes model.
+        self._kv_row_bytes = float(
+            self.cfg.num_heads
+            * self.cfg.head_dim
+            * jnp.dtype(self.cache.k.dtype).itemsize
+        )
 
     # -- jitted step bodies -------------------------------------------------
     def _sample_last(self, params, out, gather_idx, key, temp, topk):
@@ -697,7 +729,9 @@ class Engine:
                 "the paged engine prefills through prefill_paged (block-"
                 "table writes + chunking); the dense prefill has no pages"
             )
-        self.cache, self.last_token = self._prefill_jit(
+        self.cache, self.last_token = self.compile_watch.call(
+            "prefill",
+            self._prefill_jit,
             self.params,
             self.cache,
             self.last_token,
@@ -722,7 +756,9 @@ class Engine:
         ``sample_mask`` is set) as host numpy."""
         if not self.paged:
             raise ValueError("prefill_paged requires Engine(kv_pages=...)")
-        self.cache, self.last_token = self._prefill_paged_jit(
+        self.cache, self.last_token = self.compile_watch.call(
+            "prefill",
+            self._prefill_paged_jit,
             self.params,
             self.cache,
             self.last_token,
@@ -742,7 +778,9 @@ class Engine:
         """Device half of a COW remap: copy pool page ``src`` → ``dst``
         (all layers, K and V). Page ids ride as traced scalars — one
         compile serves every copy."""
-        self.cache = self._copy_page_jit(
+        self.cache = self.compile_watch.call(
+            "copy_page",
+            self._copy_page_jit,
             self.cache,
             jnp.asarray(src, jnp.int32),
             jnp.asarray(dst, jnp.int32),
@@ -752,7 +790,9 @@ class Engine:
         """One decode tick over the slot batch; returns the per-slot
         next token (host numpy; stale for inactive slots)."""
         if self.paged:
-            self.cache, self.last_token = self._decode_paged_jit(
+            self.cache, self.last_token = self.compile_watch.call(
+                "decode",
+                self._decode_paged_jit,
                 self.params,
                 self.cache,
                 self.last_token,
@@ -763,7 +803,9 @@ class Engine:
                 jnp.asarray(topk, jnp.int32),
             )
             return np.asarray(self.last_token)
-        self.cache, self.last_token = self._decode_jit(
+        self.cache, self.last_token = self.compile_watch.call(
+            "decode",
+            self._decode_jit,
             self.params,
             self.cache,
             self.last_token,
@@ -773,6 +815,98 @@ class Engine:
             jnp.asarray(topk, jnp.int32),
         )
         return np.asarray(self.last_token)
+
+    # -- roofline accounting (ISSUE 8) --------------------------------------
+    def register_roofline(self) -> dict:
+        """Register the jitted steps' ``cost_analysis()`` per-execution
+        FLOPs / HBM bytes with the installed obs recorder, under the
+        span names the scheduler uses (``prefill`` / ``decode``) — the
+        "register once at compile" half of the measured-vs-modeled
+        utilization loop (``obs.roofline``).
+
+        This AOT-lowers+compiles each step a second time (there is no
+        public way to reach the jit cache's executable); callers pay it
+        once, after warmup — ``warm_engine(register_costs=True)``, the
+        serve CLI and bench do. The modeled decode cost is the PADDED
+        number by construction; the scheduler corrects the HBM side
+        per tick with :meth:`decode_achieved_hbm_bytes`. Returns
+        ``{phase: {flops, hbm_bytes}}`` (zeros + ``error`` when a
+        backend can't report costs)."""
+        s = self.slots
+        key = jax.random.key(0)
+        f32 = jnp.zeros((s,), jnp.float32)
+        i32 = jnp.zeros((s,), jnp.int32)
+        msk = jnp.zeros((s,), bool)
+        if self.paged:
+            toks = jnp.zeros((s, self.prefill_chunk), jnp.int32)
+            bt = jnp.zeros((s, self.pages_per_slot), jnp.int32)
+            steps = {
+                "prefill": (
+                    self._prefill_paged_jit,
+                    (self.params, self.cache, self.last_token, toks, i32,
+                     i32, i32, msk, bt, key, f32, i32),
+                ),
+                "decode": (
+                    self._decode_paged_jit,
+                    (self.params, self.cache, self.last_token, msk, bt,
+                     key, f32, i32),
+                ),
+            }
+        else:
+            toks = jnp.zeros((s, self.prefill_len), jnp.int32)
+            steps = {
+                "prefill": (
+                    self._prefill_jit,
+                    (self.params, self.cache, self.last_token, toks,
+                     jnp.ones((s,), jnp.int32), msk, key, f32, i32),
+                ),
+                "decode": (
+                    self._decode_jit,
+                    (self.params, self.cache, self.last_token, msk, key,
+                     f32, i32),
+                ),
+            }
+        out = {}
+        for phase, (fn, args) in steps.items():
+            try:
+                cost = _roofline.cost_from_fn(fn, *args)
+            except Exception as e:  # a backend without AOT cost support
+                cost = {"flops": 0.0, "hbm_bytes": 0.0,
+                        "error": f"{type(e).__name__}: {e}"[:120]}
+            _roofline.register_cost(
+                phase,
+                flops=cost["flops"],
+                hbm_bytes=cost["hbm_bytes"],
+                platform=self.platform,
+            )
+            out[phase] = cost
+        self.roofline_costs = out
+        return out
+
+    def decode_achieved_hbm_bytes(self, live_lens) -> float | None:
+        """Length-aware modeled HBM bytes for ONE decode tick:
+        ``live_lens`` are the live slots' cache fills (host mirror) at
+        tick start. Visited K/V tiles come from the host formula
+        :func:`~mpit_tpu.ops.decode_attention.num_kv_blocks` — pinned
+        bitwise against the kernel's own in-kernel visited count — plus
+        one tile per clamped free slot, the param read, and the
+        appended rows. ``None`` on the dense reference engine (no
+        tiling claim to account); on the off-TPU kernel fallback the
+        figure is the MODEL of the kernel path (the platform label on
+        the registered cost marks it modeled)."""
+        if self.decode_attention == "reference":
+            return None
+        lens = np.asarray(live_lens)
+        visited = num_kv_blocks(lens, 1, self.max_len, self.decode_block_k)
+        total_tiles = int(visited.sum()) + (self.slots - lens.size)
+        return _roofline.decode_step_hbm_bytes(
+            total_tiles,
+            block_k=self.decode_block_k,
+            kv_row_bytes=self._kv_row_bytes,
+            num_layers=self.cfg.num_layers,
+            param_bytes=self._param_bytes,
+            appended_rows=lens.size,
+        )
 
     def lengths(self) -> np.ndarray:
         return np.asarray(self.cache.lengths)

@@ -26,16 +26,18 @@ traffic:
 
 ``Server.run_timed`` (``serve.scheduler``) consumes the trace: requests
 are submitted when their arrival clock comes due, never before.
-Host-side pure numpy — no jax.
+Import-light pure host python: numpy (the rng whose streams the pinned
+traces depend on) and the scheduler's ``Request`` are imported lazily,
+inside :func:`generate_arrivals` — importing THIS module pulls neither
+numpy nor (via the scheduler → ops chain) jax, which keeps CLI startup
+and the disabled hot path cheap (pinned by
+``tests/test_import_hygiene.py``).
 """
 
 from __future__ import annotations
 
 import dataclasses
-
-import numpy as np
-
-from mpit_tpu.serve.scheduler import Request
+from typing import Any
 
 __all__ = [
     "Arrival",
@@ -152,7 +154,7 @@ class Arrival:
     start — ``Server.run_timed`` maps it onto its own wall clock)."""
 
     t: float
-    request: Request
+    request: Any  # serve.scheduler.Request (imported lazily — see module doc)
     klass: str = ""
 
 
@@ -203,6 +205,14 @@ def generate_arrivals(
     is replayable; ~100 bytes/request)."""
     if duration_s <= 0:
         raise ValueError(f"duration_s must be > 0, got {duration_s}")
+    # Lazy heavyweights (import hygiene): numpy is kept — the pinned
+    # deterministic traces are RandomState streams — but only loaded
+    # when a trace is actually generated; Request pulls the scheduler
+    # (whose module chain reaches jax).
+    import numpy as np
+
+    from mpit_tpu.serve.scheduler import Request
+
     rng = np.random.RandomState(seed)
     times = _arrival_times(spec, rng, duration_s, max_requests)
     weights = np.asarray([c.weight for c in spec.classes], np.float64)

@@ -49,6 +49,18 @@ ISSUE 6 grows the loop production-shaped:
   queued (counted in ``serve_shed`` / ``Server.shed`` — the shed-rate
   SLO's numerator); unbounded by default.
 
+ISSUE 8 (roofline): every decode tick feeds the LENGTH-AWARE achieved
+HBM bytes — the engine's visited-tile model, pinned against the
+kernel's own in-kernel count — into the recorder's work accounting
+(``obs.roofline.work``), the rolling stream windows
+(``decode_hbm_bytes`` / ``decode_flops`` rates → the CLI's
+``hbmbw=``/``mfu=`` fields) and a sustained-collapse watch; the
+engine's CompileWatch is wired to this server's sentinel, so an
+unexpected mid-service recompile and a collapsing work rate land in
+the same anomaly report as tick-duration spikes. ``stats()`` carries
+``engine_compiles`` (the pinned lifetime count) and
+``decode_hbm_bytes_modeled``.
+
 ISSUE 7 (paged engine): admission becomes a PAGE grant, not just a slot
 grant — the head of the queue gets a free slot plus its whole page
 requirement (fresh pages + shared-prefix mappings + COW reserve,
@@ -80,20 +92,34 @@ from mpit_tpu.ops.decode_attention import num_kv_blocks
 __all__ = ["Request", "Completed", "Server", "warm_engine"]
 
 
-def warm_engine(engine) -> None:
+def warm_engine(engine, *, register_costs: bool = False) -> None:
     """Pay the engine's two XLA compiles (prefill + decode) with one
     throwaway request, then reset the cache — call BEFORE any timed
     window so an open-loop harness's first arrivals measure the server,
     not the compiler. Prompt content is irrelevant: the padded
-    prefill/decode buffers fix the traced shapes."""
-    warm = Server(engine)
-    warm.submit(Request(rid="warm", prompt=[1, 2, 3], max_new_tokens=2))
-    warm.run()
-    if getattr(engine, "paged", False):
-        # The COW device copy is its own (tiny) compile — a lone warm
-        # request never diverges from a shared page, so pay it here or
-        # the first real divergence pays it inside the timed window.
-        engine.copy_page(0, 0)
+    prefill/decode buffers fix the traced shapes.
+
+    The whole warm run is spanned as ``warmup`` (ISSUE 8 satellite:
+    warmup time is attributed, not a silent gap in the trace), and the
+    compiles it triggers land as ``compile`` spans + the
+    ``engine_compiles`` gauge via the engine's CompileWatch.
+    ``register_costs=True`` additionally registers the steps'
+    ``cost_analysis()`` costs with the recorder
+    (:meth:`~mpit_tpu.serve.engine.Engine.register_roofline`) — opt-in
+    because it re-compiles each step once for the cost query; bench and
+    the serve CLI pass it, parity tests don't pay it."""
+    with obs.span("warmup"):
+        warm = Server(engine)
+        warm.submit(Request(rid="warm", prompt=[1, 2, 3], max_new_tokens=2))
+        warm.run()
+        if getattr(engine, "paged", False):
+            # The COW device copy is its own (tiny) compile — a lone
+            # warm request never diverges from a shared page, so pay it
+            # here or the first real divergence pays it inside the
+            # timed window.
+            engine.copy_page(0, 0)
+        if register_costs:
+            engine.register_roofline()
     engine.reset()
 
 
@@ -205,6 +231,19 @@ class Server:
         )
         self._sampler = getattr(engine, "decode_sampler", "dense")
         self._paged = bool(getattr(engine, "paged", False))
+        # Compile + utilization sentinel rules (ISSUE 8): an unexpected
+        # engine recompile and a sustained collapse of the decode HBM
+        # rate both land in THIS server's sentinel report, next to the
+        # tick-duration findings.
+        watch = getattr(engine, "compile_watch", None)
+        if watch is not None and sentinel is not None:
+            watch.sentinel = sentinel
+        self._util_watch = (
+            obs.roofline.UtilizationWatch(sentinel=sentinel)
+            if sentinel is not None
+            else None
+        )
+        self._decode_hbm_bytes = 0.0  # length-aware modeled bytes moved
         self.queue: deque[_Live] = deque()
         self.live: dict[int, _Live] = {}  # slot -> in-flight request
         # Paged engine: slots whose prompt is still being written, one
@@ -569,7 +608,10 @@ class Server:
         obs.counter("serve_tokens", float(active.sum()))
         if self.stream is not None:
             self.stream.inc("serve_tokens", float(active.sum()))
-        if self._attn_mode == "kernel" and self.live:
+        lens = np.asarray(
+            [live.cache_fill() for live in self.live.values()]
+        )
+        if self._attn_mode == "kernel":
             # Cache tiles the length-aware kernel skipped this tick —
             # ONE formula, num_kv_blocks, shared with the kernel's own
             # in-kernel bound (pinned against it in
@@ -582,9 +624,6 @@ class Server:
             # the skipping the clamp buys.
             bk = self.engine.decode_block_k
             total = self.engine.max_len // bk
-            lens = np.asarray(
-                [live.cache_fill() for live in self.live.values()]
-            )
             visited = num_kv_blocks(lens, 1, self.engine.max_len, bk)
             n_free = self.engine.slots - lens.size
             obs.counter(
@@ -595,6 +634,27 @@ class Server:
                     - n_free  # 1 visited tile per clamped free slot
                 ),
             )
+        # Length-aware achieved work (ISSUE 8): the honest HBM figure
+        # for a tile-skipping kernel comes from the tiles it VISITS,
+        # not the padded cost_analysis buffer — fed as explicit work so
+        # the summary's decode utilization uses it, mirrored into the
+        # rolling stream windows (the CLI's hbmbw=/mfu= fields) and the
+        # sustained-collapse watch.
+        ach = getattr(self.engine, "decode_achieved_hbm_bytes", None)
+        ach = ach(lens) if ach is not None else None
+        if ach is not None:
+            self._decode_hbm_bytes += ach
+            obs.roofline.work("decode", hbm_bytes=ach)
+            costs = getattr(self.engine, "roofline_costs", None) or {}
+            flops = costs.get("decode", {}).get("flops", 0.0)
+            if self.stream is not None:
+                self.stream.inc("decode_hbm_bytes", ach)
+                if flops:
+                    self.stream.inc("decode_flops", flops)
+            if self._util_watch is not None and now > t0:
+                self._util_watch.observe(
+                    "decode_hbm_gbps", self.tick, ach / (now - t0) / 1e9
+                )
         for slot in list(self.live):
             self.live[slot].tokens.append(int(toks[slot]))
             self._maybe_retire(slot, now)
@@ -758,6 +818,16 @@ class Server:
             # — the capacity number the paged-vs-dense bench pins.
             "concurrency_peak": self._concurrency_peak,
         }
+        watch = getattr(self.engine, "compile_watch", None)
+        if watch is not None:
+            # The runtime-guarded compile claim (ISSUE 8): 2 for the
+            # dense engine's lifetime (3 paged, + copy_page) — anything
+            # above is an unexpected recompile the watch also flagged.
+            out["engine_compiles"] = watch.compiles
+        if self._decode_hbm_bytes:
+            out["decode_hbm_bytes_modeled"] = round(
+                self._decode_hbm_bytes, 1
+            )
         if self._paged:
             alloc = self.engine.allocator
             out.update(

@@ -106,6 +106,7 @@ def hardened_loop(
     prefetch_depth: int = 2,
     prefetch_max_depth: int = 8,
     sentinel=None,
+    roofline: bool = False,
 ) -> dict:
     """Drive ``step_fn`` from ``state`` to ``steps`` with full hardening.
 
@@ -164,6 +165,14 @@ def hardened_loop(
         attached to the result as ``out["sentinel"]`` — the
         ``DivergenceGuard``-for-throughput hook. ``None`` (default)
         costs nothing.
+      roofline: register the step's ``cost_analysis()`` FLOPs/bytes
+        with the installed recorder before the first step (ISSUE 8) —
+        ``obs.summary()`` then reports the run's ``step`` phase
+        mfu/hbm utilization against the chip peaks (on-chip only;
+        platform-labeled modeled cost elsewhere). Opt-in: the cost
+        query is one extra AOT compile of the step's HLO (a
+        persistent-cache replay where bench enabled one). No-op when
+        obs is disabled.
       host_transform / prefetch_workers / prefetch_depth /
         prefetch_max_depth: the prefetch pipeline (``data/loader.py``):
         ``host_transform`` runs on ``prefetch_workers`` threads before
@@ -239,6 +248,15 @@ def hardened_loop(
 
     loss_trace: list[tuple[int, float]] = []
     rate_trace: list[float] = []
+    # Compile observability (ISSUE 8): the first step's XLA compile
+    # becomes a visible `compile` span (an overlay of that step's own
+    # span — obs.core._OVERLAY_PHASES) + counter; any LATER jit-cache
+    # growth is an unexpected recompile (a shape/dtype leak into the
+    # step) — instant + sentinel note. Costs nothing when step_fn is
+    # not a jitted callable (no _cache_size) or obs is disabled.
+    compile_watch = obs.roofline.CompileWatch(
+        expected=1, scope="train_step", sentinel=sentinel
+    )
     pending: deque[_MetricFetch] = deque()
     last_eval: dict | None = None
     tracing = False
@@ -379,9 +397,28 @@ def hardened_loop(
                     ):
                         jax.profiler.start_trace(profile_dir)
                         tracing = True
+                    if roofline and step == start_step and obs.enabled():
+                        # Register once, BEFORE the first step runs (the
+                        # step may donate its input buffers — lowering
+                        # afterwards would touch deleted arrays).
+                        try:
+                            with obs.span("roofline_cost"):
+                                cost = obs.roofline.cost_from_fn(
+                                    step_fn, state, batch
+                                )
+                            obs.roofline.register_cost(
+                                "step",
+                                flops=cost["flops"],
+                                hbm_bytes=cost["hbm_bytes"],
+                                platform=jax.devices()[0].platform,
+                            )
+                        except Exception:
+                            pass  # cost support is best-effort telemetry
                     step_t0 = time.perf_counter()
                     with obs.span("step"):
-                        state, metrics = step_fn(state, batch)
+                        state, metrics = compile_watch.call(
+                            "step", step_fn, state, batch
+                        )
                     if sentinel is not None:
                         # Host-side wall per iteration (dispatch time on
                         # the async path — spikes here mean the HOST
@@ -572,6 +609,10 @@ def hardened_loop(
         # multi-x slowdowns) — the e2e img/s the rehearsal script reads.
         out["items_per_sec"] = round(max(rate_trace), 2)
         out["items_per_sec_last"] = round(rate_trace[-1], 2)
+    if compile_watch.compiles:
+        # Lifetime compiles this loop observed (expected: 1, the first
+        # step); unexpected ones were already flagged live.
+        out["compiles"] = compile_watch.compiles
     if last_eval:  # an empty sweep (val split < one batch) records nothing
         out["eval"] = last_eval
     if sentinel is not None:

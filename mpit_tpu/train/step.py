@@ -216,6 +216,15 @@ def make_train_step(
     # AOT seam: the raw jax.jit object, for `.lower()` against abstract
     # args on a topology mesh (utils/aot.py compile_multichip).
     step_fn.build = build_step
+
+    def _cache_size():
+        # Compile-watch seam (obs.roofline.CompileWatch, ISSUE 8): the
+        # jit-cache population summed over the per-structure compiled
+        # steps — growth across a call means an XLA compile happened
+        # (first step, or an unexpected shape/dtype-change recompile).
+        return sum(f._cache_size() for f in compiled.values())
+
+    step_fn._cache_size = _cache_size
     return init_fn, step_fn, state_specs
 
 

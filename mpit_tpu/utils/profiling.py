@@ -100,8 +100,13 @@ def compiled_cost(fn: Callable, *args, **kwargs) -> dict[str, float]:
 
     Returns ``{}`` keys absent when the backend doesn't report them.
     """
+    # The backend-envelope normalization (some backends wrap the
+    # properties dict in a single-element list, silently emptying every
+    # lookup below) lives in ONE place, shared with the roofline layer.
+    from mpit_tpu.obs.roofline import cost_properties
+
     compiled = jax.jit(fn).lower(*args, **kwargs).compile()
-    cost = compiled.cost_analysis() or {}
+    cost = cost_properties(compiled)
     out = {}
     for key in ("flops", "bytes accessed", "optimal_seconds"):
         if key in cost:

@@ -23,6 +23,15 @@ if (
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# NOTE (round 10): do NOT enable the persistent XLA compile cache here,
+# tempting as the ~25% compile-dominated suite wall is — on this
+# jaxlib (0.4.37) reloading a cached executable for the fake 8-device
+# CPU mesh aborts the process (XLA CHECK failure inside the second
+# build of a donated-args SPMD step; reproduced deterministically on
+# tests/test_asyncsgd.py::test_spmd_checkpoint_resume with a same-run,
+# same-platform cache). bench.py's cache stays safe because bench never
+# rebuilds an identical step inside one process.
+
 
 @pytest.fixture(scope="session")
 def n_devices() -> int:

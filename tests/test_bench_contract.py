@@ -47,7 +47,9 @@ def _realistic_results():
         "overlapped_s": {"prefetch_device_put": 0.1219},
     }
     # The perf-regression-gate snapshot bench now writes per workload
-    # (ISSUE 3; obs/baseline.py) — detail-file-only, like phases.
+    # (ISSUE 3; obs/baseline.py) — detail-file-only, like phases. The
+    # roofline section (ISSUE 8) rides the snapshot too: per-phase
+    # utilization the `obs diff` gate compares.
     obs_baseline = {
         "format": "mpit-obs-baseline-v1",
         "phases": {
@@ -55,11 +57,44 @@ def _realistic_results():
                    "p95_s": 3.123456}
             for name in ("workload", "staging", "warmup", "timed_window",
                          "hardened_loop", "host_fence", "step",
-                         "prefetch_wait")
+                         "prefetch_wait", "compile")
         },
         "counters": {"collective_bytes": 426627216.4,
-                     "collective_calls": 24.0},
+                     "collective_calls": 24.0, "compiles": 2.0},
+        "roofline": {
+            "phases": {
+                "step": {
+                    "executions": 24, "seconds": 4.527123,
+                    "platform": "tpu", "chip": "tpu-v5e",
+                    "modeled_flops_per_exec": 19456789012345.6,
+                    "modeled_hbm_bytes_per_exec": 987654321098.7,
+                    "achieved_flops": 466962936296294.4,
+                    "achieved_hbm_bytes": 23703703706368.8,
+                    "achieved_gflops_per_s": 103145.234,
+                    "achieved_hbm_gbps": 5236.123,
+                    "bound_modeled": "compute",
+                    "mfu_pct": 52.34, "hbm_util_pct": 63.93,
+                },
+            },
+        },
         "meta": {"workload": "alexnet"},
+    }
+    # The measured-vs-modeled roofline block each train workload now
+    # carries (ISSUE 8) — detail-file-only, like scaling.
+    roofline = {
+        "flops_per_step": 19456789012345.6,
+        "hbm_bytes_per_step": 987654321098.7,
+        "ici_bytes_per_step_modeled": 243786980.0,
+        "arithmetic_intensity": 19.7,
+        "measured_step_seconds": 0.188625,
+        "platform": "tpu",
+        "chip": "tpu-v5e",
+        "roofline_step_seconds_lower_bound": 0.098765,
+        "bound_modeled": "compute",
+        "mfu_pct": 52.34,
+        "hbm_util_pct": 63.93,
+        "ici_util_pct": 1.23,
+        "fraction_of_roofline": 0.5236,
     }
     return {
         "alexnet": {
@@ -67,6 +102,7 @@ def _realistic_results():
             "ms_per_step": 123.45,
             "app_path_images_per_sec": 123456.78,
             "app_path_overhead_pct": -12.34,
+            "mfu_pct": 52.34,
             "hardened_items_per_sec": 123456.78,
             "gap_attribution": gap_attribution,
             "global_batch": 2048,
@@ -76,18 +112,21 @@ def _realistic_results():
             "final_loss": 6.9078,
             "grad_sync_bytes_per_step_modeled": 243786980.0,
             "scaling": scaling,
+            "roofline": roofline,
             "phases": phases,
             "obs_baseline": obs_baseline,
         },
         "resnet50": {
             "images_per_sec": 12345.67,
             "ms_per_step": 111.36,
+            "mfu_pct": 42.12,
             "global_batch": 256,
             "batch_per_device": 256,
             "steps": 6,
             "scan_steps": 2,
             "final_loss": 6.9088,
             "scaling": scaling,
+            "roofline": roofline,
             "phases": phases,
             "obs_baseline": obs_baseline,
         },
@@ -95,6 +134,7 @@ def _realistic_results():
             "tokens_per_sec": 130301.5,
             "app_path_tokens_per_sec": 127003.1,
             "app_path_overhead_pct": -12.34,
+            "mfu_pct": 50.01,
             "hardened_items_per_sec": 127003.1,
             "gap_attribution": gap_attribution,
             "ms_per_step": 188.62,
@@ -104,12 +144,15 @@ def _realistic_results():
             "attention": "pallas-flash",
             "final_loss": 10.8262,
             "scaling": scaling,
+            "roofline": roofline,
             "phases": phases,
             "obs_baseline": obs_baseline,
         },
         "gpt2_moe": {
             "tokens_per_sec": 46123.9,
             "ms_per_step": 355.21,
+            "mfu_pct": 23.45,
+            "roofline": roofline,
             "tier": "ep",
             "batch": 32,
             "seq_len": 512,
@@ -133,6 +176,13 @@ def _realistic_results():
             "decode_tokens_per_sec": 123456.7,
             "decode_attention": "reference",
             "decode_sampler": "blocked",
+            # ISSUE 8: the length-aware achieved-bandwidth verdict +
+            # pinned compile count ride the line; the modeled GB/s and
+            # platform label are detail-only.
+            "decode_hbm_util_pct": 43.21,
+            "engine_compiles": 2,
+            "decode_hbm_gbps_modeled": 353.99,
+            "roofline_platform": "tpu",
             # ISSUE 7: the paged-cache headline triple rides the line;
             # the full capacity + chunked-prefill A/B blocks are
             # detail-file-only. Worst-case widths throughout.
@@ -301,7 +351,14 @@ class TestLineBudget:
         serve = rec["detail"]["gpt2_serve"]
         assert serve["decode_tokens_per_sec"] == 123456.7
         assert serve["decode_attention"] == "reference"
-        assert serve["latency_p50_s"] == 1.234567
+        # ISSUE 8: the utilization verdict and the pinned lifetime
+        # compile count ride the serve line; the modeled GB/s and the
+        # platform label stay detail-only.
+        assert serve["decode_hbm_util_pct"] == 43.21
+        assert serve["engine_compiles"] == 2
+        assert "decode_hbm_gbps_modeled" not in serve
+        assert "roofline_platform" not in serve
+        assert serve["latency_p95_s"] == 2.345678
         assert serve["latency_p95_s"] == 2.345678
         # ISSUE 7: the paged-cache headline triple rides the line —
         # max concurrency at the fixed HBM budget, the prefix-hit rate
@@ -310,11 +367,14 @@ class TestLineBudget:
         assert serve["kv_page_size"] == 16
         assert serve["prefix_hit_rate"] == 0.9792
         assert serve["max_concurrent_at_hbm"] == 128
+        # latency_p50_s and slots moved detail-only to pay for the
+        # ISSUE 8 keys (p95 is the SLO-relevant percentile; slots is
+        # static geometry — both stay in BENCH_DETAIL.json verbatim).
         for off_line in ("ttft_p50_s", "ttft_p95_s", "occupancy_mean",
                         "generated_tokens", "serve_tokens_per_sec",
                         "prompt_len", "ticks", "decode_sweep",
                         "decode_sampler", "paged_capacity",
-                        "chunked_prefill",
+                        "chunked_prefill", "latency_p50_s", "slots",
                         "reference_decode_tokens_per_sec"):
             assert off_line not in serve
         # The SLO sweep (ISSUE 6): the headline triple — max sustained
@@ -333,9 +393,20 @@ class TestLineBudget:
             assert off_line not in slo
         assert "dispatch" not in rec["detail"]["gpt2_moe"]
         assert "requests" not in rec["detail"]["gpt2_serve"]
+        # ISSUE 8: every train workload's mfu_pct rides the line; the
+        # full measured-vs-modeled roofline block is detail-only.
+        assert rec["detail"]["alexnet"]["mfu_pct"] == 52.34
+        assert rec["detail"]["gpt2"]["mfu_pct"] == 50.01
+        assert rec["detail"]["resnet50"]["mfu_pct"] == 42.12
+        assert rec["detail"]["gpt2_moe"]["mfu_pct"] == 23.45
+        # ...paid for by ms_per_step moving detail-only — it is exactly
+        # items_per_step / items_per_sec × 1e3, both still on the line.
+        for wl in ("alexnet", "gpt2", "resnet50", "gpt2_moe"):
+            assert "ms_per_step" not in rec["detail"][wl]
         # The obs phase breakdown is detail-only too (ISSUE 1), and
         # so are the gap ATTRIBUTION (the line carries only the pct),
-        # the perf-gate snapshot, and the MoE drop trajectory (ISSUE 3).
+        # the perf-gate snapshot, the MoE drop trajectory (ISSUE 3),
+        # and the roofline block (ISSUE 8).
         for wl in rec["detail"].values():
             if isinstance(wl, dict):
                 assert "phases" not in wl
@@ -343,6 +414,7 @@ class TestLineBudget:
                 assert "hardened_items_per_sec" not in wl
                 assert "obs_baseline" not in wl
                 assert "drop_rate_trajectory" not in wl
+                assert "roofline" not in wl
 
     def test_partial_record_parses(self):
         # Progressive emission: record printed after the headline only,

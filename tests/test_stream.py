@@ -251,6 +251,63 @@ class TestStreamRegistry:
         assert ws["gauges"]["queue_depth"] == 3.0
 
 
+class TestLongIdleAging:
+    """ISSUE 8 satellite: ``run_timed`` sleeps to the next arrival, so
+    a window query can land after an ARBITRARILY long idle stretch —
+    the windows must age out correctly (no stale p95 reported as live),
+    including the subtle case where the idle span is an exact multiple
+    of the ring length and the fresh interval REUSES a stale slot."""
+
+    def test_ring_slot_collision_after_exact_multiple_idle(self):
+        # interval_s = 1, 10 slots: t=0.5 and t=1000.5 hash to the SAME
+        # ring slot (1000 % 10 == 0). The stale sub-sketch must be
+        # replaced, never merged — or the old era's value would leak
+        # into the live window as a current observation.
+        w = WindowedHistogram(window_s=10.0, intervals=10)
+        w.observe(10.0, t=0.5)  # slow era
+        w.observe(0.1, t=1000.5)  # fast era, colliding slot
+        assert w.count(now=1000.5) == 1
+        assert w.quantile(0.95, now=1000.5) == pytest.approx(0.1, rel=0.03)
+        assert w.total.count == 2  # all-time view keeps both
+
+    def test_mid_idle_queries_report_empty_not_stale(self):
+        w = WindowedHistogram(window_s=4.0, intervals=4)
+        for i in range(8):
+            w.observe(5.0, t=i * 0.5)
+        # Query DURING the idle stretch, long after the last arrival:
+        # nothing is live — stale p95s must not survive as answers.
+        for now in (60.0, 61.5, 997.0):
+            assert w.count(now=now) == 0
+            assert w.quantile(0.95, now=now) is None
+        # Traffic resumes: the window reflects only the new era.
+        w.observe(0.5, t=1000.0)
+        assert w.quantile(0.5, now=1000.1) == pytest.approx(0.5, rel=0.03)
+
+    def test_rates_decay_to_zero_and_recover_after_idle(self):
+        reg = StreamRegistry(window_s=10.0, clock=lambda: 0.0)
+        for i in range(20):
+            reg.inc("tok", value=5.0, t=i * 0.5)
+        assert reg.rate("tok", now=10.0) > 0
+        assert reg.rate("tok", now=500.0) == 0.0
+        assert reg.window_total("tok", now=500.0) == 0.0
+        # Exact-multiple idle (500 s over a 10 s ring): the colliding
+        # slot's stale count must not resurrect.
+        reg.inc("tok", value=2.0, t=500.0)
+        assert reg.window_total("tok", now=500.1) == 2.0
+        assert reg.counter_total("tok") == 102.0  # all-time survives
+
+    def test_window_stats_after_idle_has_no_stale_percentiles(self):
+        reg = StreamRegistry(window_s=5.0, clock=lambda: 0.0)
+        reg.observe("request_ttft", 0.3, t=0.0)
+        reg.inc("serve_arrivals", t=0.0)
+        ws = reg.window_stats(now=300.0)
+        # Count 0 and NO p50/p95 keys: a consumer (the CLI live line,
+        # the SLO monitor) can't mistake the old era for live traffic.
+        assert ws["histograms"]["request_ttft"]["count"] == 0
+        assert "p95" not in ws["histograms"]["request_ttft"]
+        assert ws["rates"]["serve_arrivals"]["rate_per_s"] == 0.0
+
+
 class _FakeClock:
     def __init__(self, t=0.0):
         self.t = t
