@@ -1439,13 +1439,57 @@ def bench_gpt2_slo(
     }
 
 
-def bench_allreduce(payload_mb: int = 64, iters: int = 10):
-    """The BASELINE "allreduce GB/s" metric.
+def _q8_wire_bytes(payload_bytes: int, p: int) -> float:
+    """ACTUAL wire-equivalent payload of a quantized (int8 + per-chunk
+    scale) ring over an f32 payload — the ring planner's own figure
+    (ISSUE 9: modeled q8 numbers use the quantized size, never the
+    logical one)."""
+    from mpit_tpu.ops.ring_collectives import plan_ring
 
-    Measured only when >1 device exists; on the 1-chip environment the
-    collective is a no-op, so a modeled figure (ICI roofline for a
-    hypothetical 8-chip ring) is reported and labeled — never passed off
-    as measured (SURVEY.md §8.4.5).
+    plan = plan_ring(payload_bytes // 4, p, "int8")
+    return plan.wire_payload_bytes("int8", scales=True)
+
+
+def _modeled_allreduce_curves(mbs, p: int = 8):
+    """Modeled GB/s per payload for the three sync variants (psum and
+    ring share the ring-allreduce model — XLA's psum IS a ring; q8 runs
+    the same model at its int8 wire size, reported as ALGORITHM GB/s —
+    logical payload over wall time, the EQuARX framing where the
+    quantized collective looks ~4× faster because it moves ~¼ the
+    bytes). Modeled, labeled, never passed off as measured."""
+    from mpit_tpu.utils import (
+        modeled_all_gather_seconds,
+        modeled_allreduce_seconds,
+        modeled_reduce_scatter_seconds,
+    )
+
+    out = {}
+    for mb in mbs:
+        payload = mb * 2**20
+        t_ring = modeled_allreduce_seconds(payload, p)
+        wire_q8 = _q8_wire_bytes(payload, p)
+        t_q8 = modeled_reduce_scatter_seconds(
+            wire_q8, p
+        ) + modeled_all_gather_seconds(wire_q8, p)
+        out[str(mb)] = {
+            "psum": round(payload / t_ring / 1e9, 2),
+            "ring": round(payload / t_ring / 1e9, 2),
+            "q8": round(payload / t_q8 / 1e9, 2),
+        }
+    return out
+
+
+def bench_allreduce(payload_mb: int = 64, iters: int = 10):
+    """The BASELINE "allreduce GB/s" metric — now a three-way record
+    (ISSUE 9): stock ``lax.psum`` vs the in-kernel Pallas ring vs the
+    quantized (int8 + per-chunk scales) ring.
+
+    Measured only on TPU with >1 device; elsewhere (1 chip, or a CPU
+    mesh whose "wire" is memcpy) the latency-aware ICI ring model for 8
+    chips is reported and labeled — never passed off as measured
+    (SURVEY.md §8.4.5). GB/s is ALGORITHM bandwidth (logical payload /
+    time, the MPI convention) for every variant — the q8 figure exceeds
+    the wire ceiling by design since its wire bytes are ~¼ the payload.
     """
     import mpit_tpu
     from jax.sharding import PartitionSpec as P
@@ -1454,55 +1498,97 @@ def bench_allreduce(payload_mb: int = 64, iters: int = 10):
 
     world = mpit_tpu.init()
     n = world.num_devices
+    platform = jax.devices()[0].platform
     payload = payload_mb * 1024 * 1024
-    if n == 1:
+    if n == 1 or platform != "tpu":
         from mpit_tpu.utils import modeled_allreduce_seconds
 
         # Latency-aware ring model (utils/profiling.py): the derived
-        # GB/s now MOVES with payload (small payloads latency-bound,
-        # large ones approach the 2×ICI wire ceiling) instead of the
-        # constant a latency-free model produced for four rounds
-        # (round-5 verdict missing #3). Still modeled, still labeled.
+        # GB/s MOVES with payload (small payloads latency-bound, large
+        # ones approach the 2×ICI wire ceiling). Off-TPU the ring
+        # kernels fall back to lax anyway (mode-stamped), so a
+        # multi-device CPU "measurement" would time memcpy — the model
+        # is the only honest figure here. Still modeled, still labeled.
         modeled = payload / modeled_allreduce_seconds(payload, 8) / 1e9
+        curves = _modeled_allreduce_curves((1, 4, 16, 64, 256))
+        at = curves[str(payload_mb)] if str(payload_mb) in curves else (
+            _modeled_allreduce_curves((payload_mb,))[str(payload_mb)]
+        )
         return {
             "gbps": round(modeled, 2),
+            # ring == psum by model (both are bandwidth-optimal rings);
+            # the MEASURED separation is what a TPU run records.
+            "ring_gbps": at["ring"],
+            "q8_gbps": at["q8"],
             "modeled": True,
+            "platform": platform,
             "payload_mb": payload_mb,
-            "by_payload_mb": {
-                str(mb): round(
-                    (mb * 2**20)
-                    / modeled_allreduce_seconds(mb * 2**20, 8) / 1e9,
-                    2,
-                )
-                for mb in (1, 4, 16, 64, 256)
-            },
+            "by_payload_mb": curves,
+            "q8_wire_bytes_at_payload": round(_q8_wire_bytes(payload, 8)),
             "ici_hop_latency_us_assumed": TPU_V5E.ici_hop_latency * 1e6,
-            "note": "1 device: no-op collective; latency-aware ICI ring "
-                    "estimate for 8 chips",
+            "note": f"{n} device(s) on {platform}: latency-aware ICI "
+                    "ring estimate for 8 chips; no GB/s measured off-TPU",
         }
-    # MPI convention (and the modeled branch above): ``payload`` is the
-    # PER-RANK buffer each device reduces — so lay out n × payload bytes
-    # globally, one payload-sized shard per device.
-    x = jnp.ones((n, payload // 4), jnp.float32)
-    f = jax.jit(
-        world.shard_map(
-            lambda v: C.allreduce(v, "data"),
-            in_specs=P("data"),
-            out_specs=P("data"),
-        )
+    # Ring variants measure the BUCKETED production path (GradSync,
+    # 4 MB buckets — the configuration grad_sync="ring|ring_q8"
+    # actually runs): the ring kernels are VMEM-resident, so a
+    # monolithic 64 MB payload would not even compile; the bucket loop
+    # is the real wire schedule. allreduce_grads is mean-semantics
+    # (sum + a scalar multiply) — bandwidth-equivalent to psum.
+    from mpit_tpu.train import GradSync
+
+    ring_sync = GradSync("data", "ring")
+    q8_sync = GradSync("data", "ring_q8")
+    variants = (
+        ("psum", lambda v: C.allreduce(v, "data")),
+        ("ring", lambda v: ring_sync.allreduce_grads(v)),
+        ("q8", lambda v: q8_sync.allreduce_grads(v)),
     )
-    out = f(x)
-    float(out[0, 0])  # warm + force
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = f(out)
-    float(out[0, 0])
-    dt = (time.perf_counter() - t0) / iters
+
+    def timed(body, xs, reps):
+        # MPI convention (and the modeled branch above): each device
+        # reduces a payload-sized PER-RANK buffer — n × payload bytes
+        # globally, one shard per device.
+        f = jax.jit(
+            world.shard_map(body, in_specs=P("data"), out_specs=P("data"))
+        )
+        out = f(xs)
+        float(out[0, 0])  # warm + force
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(out)
+        float(out[0, 0])
+        return (time.perf_counter() - t0) / reps
+
+    # One pass over the ladder; the headline payload is measured at the
+    # full ``iters`` count and its row doubles as the headline figures
+    # (no second compile+measurement of the same geometry).
+    ladder = {}
+    for mb in (1, 4, 16, 64, 256):
+        pl_b = mb * 2**20
+        xs = jnp.ones((n, pl_b // 4), jnp.float32)
+        reps = iters if pl_b == payload else max(3, iters // 2)
+        ladder[str(mb)] = {
+            name: round(allreduce_gbps(pl_b, n, timed(body, xs, reps)), 2)
+            for name, body in variants
+        }
+    headline = ladder.get(str(payload_mb))
+    if headline is None:  # off-ladder payload: measure it directly
+        xs = jnp.ones((n, payload // 4), jnp.float32)
+        headline = {
+            name: round(allreduce_gbps(payload, n, timed(body, xs, iters)), 2)
+            for name, body in variants
+        }
     return {
-        "gbps": round(allreduce_gbps(payload, n, dt), 2),
+        "gbps": headline["psum"],
+        "ring_gbps": headline["ring"],
+        "q8_gbps": headline["q8"],
         "modeled": False,
+        "platform": platform,
         "devices": n,
         "payload_mb": payload_mb,
+        "by_payload_mb": ladder,
+        "q8_wire_bytes_at_payload": round(_q8_wire_bytes(payload, n)),
     }
 
 
@@ -1563,14 +1649,19 @@ _LINE_KEYS = {
         "images_per_sec", "app_path_overhead_pct", "mfu_pct",
         "global_batch", "final_loss", "error",
     ),
+    # To pay for ISSUE 9's allreduce pair inside the ≤1.2k budget,
+    # static config echo moved detail-only: resnet50's global_batch and
+    # gpt2's seq_len (both fixed workload geometry, in BENCH_DETAIL.json
+    # verbatim), plus the allreduce entry's devices (byte-for-byte the
+    # record's top-level detail.devices).
     "resnet50": (
-        "images_per_sec", "mfu_pct", "global_batch", "final_loss",
+        "images_per_sec", "mfu_pct", "final_loss",
         "error",
     ),
     "gpt2": (
         "tokens_per_sec", "app_path_tokens_per_sec",
         "app_path_overhead_pct", "mfu_pct", "batch",
-        "seq_len", "attention", "final_loss", "error",
+        "attention", "final_loss", "error",
     ),
     "gpt2_moe": (
         "tokens_per_sec", "mfu_pct", "batch", "seq_len",
@@ -1602,7 +1693,10 @@ _LINE_KEYS = {
         "max_sustained_req_per_s", "ttft_target_s", "slo_breaches",
         "error",
     ),
-    "allreduce": ("gbps", "modeled", "devices", "error"),
+    # ISSUE 9: the ring and quantized-ring figures ride the line next to
+    # the stock one (modeled off-TPU — the `modeled` flag labels all
+    # three); the per-payload three-variant curve stays detail-only.
+    "allreduce": ("gbps", "ring_gbps", "q8_gbps", "modeled", "error"),
 }
 
 

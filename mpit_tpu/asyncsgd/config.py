@@ -51,6 +51,17 @@ class TrainConfig:
     # GooState.count lands on a reshaped LR curve (RECOVERY.md).
     schedule_horizon: int = 0
     zero1: bool = True  # shard goo state across the data axis (SPMD mode)
+    # Gradient-sync wire tier (ISSUE 9; train/grad_sync.py):
+    # "psum" = stock XLA collectives (default, seed behavior);
+    # "ring" = in-kernel Pallas ring reduce-scatter/all-gather, issued
+    # per grad bucket (numerically identical to psum — pinned);
+    # "ring_q8" = the ring with the int8 quantized wire (per-chunk
+    # scales, ~1/4 the wire bytes) — LOSSY: trajectory differs from
+    # f32 sync by design (loss-curve-pinned within noise), so resuming
+    # a psum/ring checkpoint under ring_q8 (or back) changes the
+    # trajectory like any lossy knob would.
+    grad_sync: str = "psum"  # psum | ring | ring_q8
+    grad_bucket_mb: float = 4.0  # ring tiers: bucket size (MB of f32)
     easgd: bool = False  # elastic-averaging dynamics instead of Downpour
     easgd_alpha: float = 0.125
     sync_every: int = 1  # parity mode: client steps between server exchanges

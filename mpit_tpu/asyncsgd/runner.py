@@ -189,7 +189,15 @@ def run_meta(cfg: TrainConfig) -> dict:
         "crop_pad": cfg.crop_pad if cfg.augment else 0,
         "train_size": cfg.train_size,
         "easgd": cfg.easgd,
+        # ISSUE 9: ring_q8 sync is LOSSY — the trajectory depends on the
+        # wire mode, so it pins. (plain "ring" is numerically identical
+        # to psum — pinning the mode anyway keeps the record honest, but
+        # bucket size only shapes the trajectory under q8, where bucket
+        # boundaries define the per-chunk quantization scales.)
+        "grad_sync": cfg.grad_sync,
     }
+    if cfg.grad_sync == "ring_q8":
+        meta["grad_q8_bucket_mb"] = cfg.grad_bucket_mb
     if cfg.easgd:
         meta["easgd_alpha"] = cfg.easgd_alpha
     base_fields = {f.name for f in dataclasses.fields(TrainConfig)}
@@ -273,7 +281,8 @@ def run_spmd(
         tx = build_tx(cfg, axis=axis)
 
     init_fn, step_fn, state_specs = make_train_step(
-        loss_fn, tx, world, axis=axis, zero1=cfg.zero1, stateful=stateful
+        loss_fn, tx, world, axis=axis, zero1=cfg.zero1, stateful=stateful,
+        grad_sync=cfg.grad_sync, grad_bucket_mb=cfg.grad_bucket_mb,
     )
 
     if (cfg.resume_dense or cfg.save_dense) and (
@@ -366,11 +375,18 @@ def run_spmd(
     # Per-step ICI traffic model (SURVEY.md §6 metrics row), logged once.
     # Gradient sync rides the data axis only, so size by that axis (a
     # multi-axis mesh's model/pipe dims don't carry grad allreduce).
+    # wire_scale: a quantized sync (grad_sync="ring_q8") ships int8 on
+    # the wire — the model must see the ACTUAL size, not the logical
+    # one (ISSUE 9; GradSync.wire_scale is the matching authority).
+    from mpit_tpu.train.grad_sync import GradSync as _GradSync
+
+    _grad_dtype = jnp.result_type(*jax.tree.leaves(params))
     comm = profiling.CommModel(
         params,
         world.axis_size(axis),
         zero1=cfg.zero1,
         num_slices=world.dcn_factor(axis),
+        wire_scale=_GradSync(axis, cfg.grad_sync).wire_scale(_grad_dtype),
     )
     logger.log(start_step, {"comm_" + k: v for k, v in comm.summary().items()})
 

@@ -55,12 +55,37 @@ def _pvary(x, names):
         return lax.pvary(x, names)
     return x
 
+
+def unvary(x, names):
+    """Varying→replicated retype for a value PROVEN identical on every
+    device along ``names`` — the claim ``all_gather_invariant`` makes
+    for its own output, extended to values whose invariance the caller
+    establishes by construction (a ring all-gather's output, a
+    ppermute-circulated broadcast). Pre-VMA jax: identity. A WRONG use
+    (value actually differs per device) silently desynchronizes
+    replicas — callers own the proof."""
+    if hasattr(lax, "pcast"):
+        for to in ("invariant", "replicated"):
+            try:
+                return lax.pcast(x, names, to=to)
+            except (TypeError, ValueError):
+                continue
+    return x
+
 AxisName = str | Sequence[str]
 
 _REDUCE_OPS = ("sum", "mean", "max", "min", "prod")
 
 
-def _rec(op: str, x, axis: AxisName, *, model: str | None = None) -> None:
+def _rec(
+    op: str,
+    x,
+    axis: AxisName,
+    *,
+    model: str | None = None,
+    payload_bytes: float | None = None,
+    mode: str | None = None,
+) -> None:
     """Trace-time telemetry for a collective (mpit_tpu.obs; no-op when
     obs is disabled — one global read).
 
@@ -71,7 +96,13 @@ def _rec(op: str, x, axis: AxisName, *, model: str | None = None) -> None:
     analogue of the CommModel accounting. ``model``: the wire-model
     name (default ``op``); ``None`` payload models (permute/shift/
     send_to/recv_from) charge the full buffer — each device forwards
-    its whole shard once.
+    its whole shard once. ``payload_bytes`` overrides the payload
+    derived from ``x`` — the quantized ring collectives charge their
+    ACTUAL wire-sized payload (int8 chunks + scale blocks ≈ ¼ the
+    logical bytes), never the logical one (ISSUE 9: the roofline ICI
+    accounting and the P2P matrix must see the quantized size).
+    ``mode`` stamps the executed-mode label (``ring``/``psum_fallback``
+    /``lax_emulated``) so a fallback run cannot be misattributed.
     """
     from mpit_tpu.obs import core as _obs
 
@@ -83,10 +114,14 @@ def _rec(op: str, x, axis: AxisName, *, model: str | None = None) -> None:
         for a in names:
             p = p * lax.axis_size(a)
         p = int(p)
-        payload = sum(
-            l.size * l.dtype.itemsize
-            for l in jax.tree.leaves(x)
-            if hasattr(l, "dtype")
+        payload = (
+            float(payload_bytes)
+            if payload_bytes is not None
+            else sum(
+                l.size * l.dtype.itemsize
+                for l in jax.tree.leaves(x)
+                if hasattr(l, "dtype")
+            )
         )
     except Exception:
         return  # outside a mesh context / abstract axis: nothing to charge
@@ -97,11 +132,12 @@ def _rec(op: str, x, axis: AxisName, *, model: str | None = None) -> None:
     else:
         wire = collective_bytes(payload, p, model or op)
     axis_label = ",".join(names)
-    _obs.counter("collective_bytes", wire, op=op, axis=axis_label)
-    _obs.counter("collective_calls", 1, op=op, axis=axis_label)
+    extra = {"mode": mode} if mode else {}
+    _obs.counter("collective_bytes", wire, op=op, axis=axis_label, **extra)
+    _obs.counter("collective_calls", 1, op=op, axis=axis_label, **extra)
     _obs.instant(
         f"collective:{op}", axis=axis_label, payload_bytes=payload,
-        wire_bytes_per_device=wire, devices=p,
+        wire_bytes_per_device=wire, devices=p, **extra,
     )
 
 

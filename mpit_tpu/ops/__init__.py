@@ -4,9 +4,15 @@ Where the reference's native stratum is a C binding handing Torch tensor
 pointers to libmpi (SURVEY.md §2 L0), this framework's native stratum is
 hand-scheduled TPU kernels below the XLA tier:
 
-- :mod:`mpit_tpu.ops.ring_allreduce` — ring reduce-scatter + all-gather
-  over ICI via double-buffered ``make_async_remote_copy`` (the
-  ``MPI_Allreduce`` hot path, SURVEY.md §4.3; the "allreduce GB/s" metric).
+- :mod:`mpit_tpu.ops.ring_collectives` — composable ring
+  reduce-scatter / all-gather over ICI via double-buffered
+  ``make_async_remote_copy`` (shared host-side planner for
+  non-divisible shapes, shared mailbox discipline), plus the
+  EQuARX-spirit quantized variants (int8 wire with per-chunk scales) —
+  the gradient-sync building blocks (ISSUE 9).
+- :mod:`mpit_tpu.ops.ring_allreduce` — their composition: the
+  ``MPI_Allreduce`` hot path (SURVEY.md §4.3; the "allreduce GB/s"
+  metric), ``op="qsum"`` for the quantized wire.
 - :mod:`mpit_tpu.ops.flash_attention` — fused blockwise causal attention
   (online softmax; never materializes the [T, T] score matrix) with a
   Flash-2 custom-VJP backward, the GPT-2 inner kernel and the per-shard
@@ -40,6 +46,15 @@ from mpit_tpu.ops.flash_attention import (
 )
 from mpit_tpu.ops.lm_head import lm_head_sample, lm_head_xent
 from mpit_tpu.ops.ring_allreduce import ring_allreduce
+from mpit_tpu.ops.ring_collectives import (
+    RingPlan,
+    dequantize_chunk,
+    plan_ring,
+    plan_shards,
+    quantize_chunk,
+    ring_all_gather,
+    ring_reduce_scatter,
+)
 
 __all__ = [
     "flash_attention",
@@ -52,4 +67,11 @@ __all__ = [
     "lm_head_sample",
     "lm_head_xent",
     "ring_allreduce",
+    "RingPlan",
+    "dequantize_chunk",
+    "plan_ring",
+    "plan_shards",
+    "quantize_chunk",
+    "ring_all_gather",
+    "ring_reduce_scatter",
 ]

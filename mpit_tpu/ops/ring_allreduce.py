@@ -7,204 +7,79 @@ ring; this module is the hand-scheduled equivalent — the kernel the
 "allreduce GB/s" benchmark measures and the in-tree proof that the
 framework owns its communication stack down to the DMA level.
 
-Algorithm (classic two-phase ring, bandwidth-optimal 2·(P-1)/P · N):
+ISSUE 9 refactor: the seed's monolithic two-phase kernel is now the
+COMPOSITION of the factored ring collectives (``ops/ring_collectives.py``)
+— ``ring_allreduce = ring_all_gather ∘ ring_reduce_scatter`` (the classic
+bandwidth-optimal ``2·(P-1)/P·N`` decomposition, arXiv 2112.01075's
+portable factoring). The DMA-semaphore mailbox discipline the seed kernel
+pioneered (neighbor barrier, double-buffered receive slots, capacity
+tokens, drain — pinned by tests in TPU interpret mode) lives once in
+``ring_collectives._Ring``; the padding/chunking for non-divisible shapes
+lives once in the shared host-side planner (``plan_ring``).
 
-1. **Reduce-scatter** (P-1 steps): the payload is split into P chunks; at
-   step s every device sends its running sum of chunk ``(i-s) mod P`` one
-   hop clockwise through a double-buffered VMEM mailbox
-   (``make_async_remote_copy``) and adds the chunk arriving from its left
-   neighbor. After P-1 steps device i holds the fully-reduced chunk
-   ``(i+1) mod P``.
-2. **All-gather** (P-1 steps): the owned chunks circulate; each arriving
-   chunk is copied from the mailbox into its slot of the output.
-
-Synchronization discipline (pinned down by tests/test_ops.py in TPU
-interpret mode):
-- a neighbor barrier (``get_barrier_semaphore``) before the first send, so
-  no device writes into a mailbox that is not yet live;
-- remote writes land ONLY in the double-buffered receive mailbox
-  (``recv_buf``); the send staging buffer (``send_buf``) is strictly
-  device-local, so an early neighbor can never clobber a send in flight;
-- ``rdma.wait()`` blocks on both the local send completion (making
-  ``send_buf`` safe to restage next step) and the remote delivery into
-  THIS device's ``recv_buf[g % 2]``;
-- capacity tokens: a landing slot is reused every 2 steps, and the reuse
-  at step g is gated on the receiver's "read done" token from step g-2 —
-  signaled only AFTER the receiver consumed the slot into its output.
+``op="qsum"`` selects the EQuARX-spirit quantized wire (arXiv
+2506.17615): int8 chunks with per-chunk scales, quantized in-kernel,
+dequant-accumulated in f32 — ~¼ the wire bytes of an f32 payload (½ of
+bf16), lossy by design (callers opt in explicitly; the training
+loss-curve pin is the contract, bit-match is NOT claimed).
 """
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from mpit_tpu.comm.collectives import _pvary
-
-_LANE = 128
-_SUBLANE = 8  # float32 tile rows
-
-
-def _kernel(
-    x_ref,
-    o_ref,
-    send_buf,
-    recv_buf,
-    send_sem,
-    recv_sem,
-    cap_sem,
-    *,
-    axis: str,
-    num_devices: int,
-    interpret: bool,
-):
-    p = num_devices
-    i = lax.axis_index(axis)
-    right = lax.rem(i + 1, p)
-    left = lax.rem(i - 1 + p, p)
-    rows = x_ref.shape[0] // p  # rows per chunk
-
-    o_ref[...] = x_ref[...]
-
-    if p == 1:
-        return
-
-    # Neighbor barrier: both neighbors must have entered the kernel (their
-    # mailboxes and output buffers are live) before any remote write.
-    barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left})
-    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right})
-    pltpu.semaphore_wait(barrier, 2)
-
-    total = 2 * (p - 1)  # continuous step counter across both phases
-
-    def step(g, send_c, recv_c, *, accumulate):
-        """One ring step: stage chunk ``send_c`` and ship it right; fold the
-        chunk arriving from the left into output slot ``recv_c``."""
-        # Back-pressure: the right neighbor's landing slot g%2 is reused
-        # every 2 steps; wait for its "read done" token from step g-2
-        # before writing into it again. Without this a fast sender runs
-        # 2+ steps ahead and clobbers unconsumed data (two slots alone
-        # are NOT a protocol).
-        if g >= 2:
-            pltpu.semaphore_wait(cap_sem.at[g % 2], 1)
-        send_buf[...] = o_ref[pl.ds(send_c * rows, rows), :]
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=send_buf,
-            dst_ref=recv_buf.at[g % 2],
-            send_sem=send_sem,
-            recv_sem=recv_sem.at[g % 2],
-            device_id={axis: right},
-        )
-        rdma.start()
-        # Blocks on BOTH: my outgoing DMA finished reading send_buf (so the
-        # next step may restage it) AND the left neighbor's chunk arrived
-        # in recv_buf[g%2]. send_buf is never a remote-write target, so no
-        # neighbor progress can corrupt a send in flight.
-        rdma.wait()
-        # _pvary feeds the interpret-mode VMA checker only; the real TPU
-        # Mosaic lowering has no VMA tracking and rejects the primitive
-        # (caught by the v5e-8 AOT compile check, utils/aot.py).
-        incoming = recv_buf[g % 2]
-        if interpret:
-            incoming = _pvary(incoming, (axis,))
-        if accumulate:
-            o_ref[pl.ds(recv_c * rows, rows), :] += incoming
-        else:
-            o_ref[pl.ds(recv_c * rows, rows), :] = incoming
-        # Landing slot consumed — only now may the left neighbor reuse it
-        # (its step g+2).
-        pltpu.semaphore_signal(cap_sem.at[g % 2], inc=1, device_id={axis: left})
-
-    # Python loops, not fori_loop: p is static, and the step index must stay
-    # a Python int so chunk indices are pure functions of the (device-
-    # varying) axis_index — the interpreter's VMA checker rejects mixing a
-    # replicated loop carry into varying address arithmetic.
-    # ---- phase 1: reduce-scatter -----------------------------------------
-    for s in range(p - 1):
-        step(
-            s,
-            send_c=lax.rem(i - s + p, p),
-            recv_c=lax.rem(i - s - 1 + 2 * p, p),
-            accumulate=True,
-        )
-
-    # ---- phase 2: all-gather ---------------------------------------------
-    # Device i now owns reduced chunk (i+1) mod p; circulate ownership.
-    for s in range(p - 1):
-        step(
-            (p - 1) + s,
-            send_c=lax.rem(i + 1 - s + 2 * p, p),
-            recv_c=lax.rem(i - s + 2 * p, p),
-            accumulate=False,
-        )
-
-    # Drain: the final two "read done" tokens (one per slot, from steps
-    # total-1 and total-2) have no matching send-side wait; absorb them so
-    # the semaphores return to zero for the next call.
-    pltpu.semaphore_wait(cap_sem.at[(total - 1) % 2], 1)
-    pltpu.semaphore_wait(cap_sem.at[(total - 2) % 2], 1)
+from mpit_tpu.comm.collectives import _rec
+from mpit_tpu.ops.ring_collectives import (
+    executed_mode,
+    ring_all_gather,
+    ring_reduce_scatter,
+)
 
 
-def _ring_allreduce_2d(x2d, *, axis: str, interpret: bool):
-    p = lax.axis_size(axis)
-    kern = functools.partial(
-        _kernel, axis=axis, num_devices=p, interpret=interpret
-    )
-    rows = x2d.shape[0] // p
-    return pl.pallas_call(
-        kern,
-        # vma: the result is device-varying over the ring axis (shard_map
-        # VMA checker requires kernels to declare this explicitly).
-        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype, vma=frozenset({axis})),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((rows, _LANE), x2d.dtype),  # send staging (local-only)
-            pltpu.VMEM((2, rows, _LANE), x2d.dtype),  # receive mailbox
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.REGULAR((2,)),  # per-slot capacity tokens
-        ],
-        compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=0
-        ),
-        # TPU interpret mode (not the generic pallas interpreter): simulates
-        # remote DMAs + semaphores across shard_map "devices" on CPU.
-        interpret=pltpu.InterpretParams() if interpret else False,
-    )(x2d)
+def ring_allreduce(x, axis: str, *, op: str = "sum", interpret: bool = False):
+    """All-reduce ``x`` over mesh axis ``axis`` — call inside shard_map.
 
+    Accepts any shape/f32-or-bf16 dtype; the payload is raveled, padded
+    by the shared ring planner, reduce-scattered and all-gathered
+    through the Pallas ring, and restored. ``interpret=True`` runs the
+    TPU interpret mode (works on the CPU fake mesh — the
+    semaphore-discipline sanitizer of SURVEY.md §6).
 
-def ring_allreduce(x, axis: str, *, interpret: bool = False):
-    """All-reduce-sum ``x`` over mesh axis ``axis`` — call inside shard_map.
-
-    Accepts any shape/f32-or-bf16 dtype; the payload is raveled, padded to
-    a [P · 8, 128] tile multiple, pushed through the Pallas ring, and
-    restored. ``interpret=True`` runs the TPU interpret mode (works on the
-    CPU fake mesh — the semaphore-discipline sanitizer of SURVEY.md §6).
-
-    Equivalent to ``lax.psum(x, axis)``; exists as the native tier and for
-    the GB/s benchmark. On non-TPU backends (where Mosaic can't lower the
-    remote DMAs) the compiled path falls back to ``lax.psum`` — only
-    ``interpret=True`` runs the actual ring protocol off-TPU.
+    ``op="sum"`` is equivalent to ``lax.psum(x, axis)``; ``op="qsum"``
+    is the quantized wire (int8 + per-chunk scales — lossy, explicit
+    opt-in; result cast back to ``x.dtype``). On non-TPU backends
+    (where Mosaic can't lower the remote DMAs) the compiled path falls
+    back to the exact ``lax`` composition — ``lax.psum`` for ``sum``,
+    the ppermute-spelled quantized ring for ``qsum`` — and the executed
+    mode (``ring`` | ``psum_fallback`` | ``lax_emulated``) is stamped
+    into the obs trace so a fallback run can never be misattributed as
+    a kernel measurement (ISSUE 9 satellite).
     """
-    if not interpret and jax.devices()[0].platform != "tpu":
-        return lax.psum(x, axis)
+    if op not in ("sum", "qsum"):
+        raise ValueError(f"op must be 'sum' or 'qsum', got {op!r}")
     p = lax.axis_size(axis)
     if p == 1:
-        # Degenerate ring: x already equals the sum. Entering the kernel
-        # would deadlock — both phase loops are empty (no capacity tokens
-        # ever signaled) while the drain waits on two of them.
+        # Degenerate ring: x already equals the sum. Entering the
+        # kernels would deadlock — the phase loops are empty (no
+        # capacity tokens ever signaled) while the drain waits on them.
         return x
+    mode = executed_mode(op, interpret)
+    if mode == "psum_fallback":
+        # Stamped at the ACTUAL payload and mode — the seed kernel fell
+        # back silently, which let bench/traces attribute psum numbers
+        # to the ring (ISSUE 9 satellite).
+        _rec("ring_allreduce", x, axis, model="allreduce", mode=mode)
+        return lax.psum(x, axis)
+    # Composition: the per-phase wrappers charge their own (actual,
+    # quantized-size-aware) wire bytes and stamp the per-phase mode.
     flat = jnp.ravel(x)
-    n = flat.shape[0]
-    sublane = 16 if x.dtype == jnp.bfloat16 else _SUBLANE
-    pad = (-n) % (p * sublane * _LANE)
-    flat = jnp.pad(flat, (0, pad))
-    x2d = flat.reshape(-1, _LANE)
-    out = _ring_allreduce_2d(x2d, axis=axis, interpret=interpret)
-    return out.reshape(-1)[:n].reshape(x.shape)
+    shard = ring_reduce_scatter(flat, axis, op=op, interpret=interpret)
+    full = ring_all_gather(
+        shard.astype(x.dtype) if op == "qsum" else shard,
+        axis,
+        quantized=(op == "qsum"),
+        interpret=interpret,
+    )
+    return full[: flat.shape[0]].reshape(x.shape).astype(x.dtype)

@@ -161,6 +161,7 @@ def sharded(
     axis: str,
     *,
     mean_grads: bool = True,
+    comm=None,
 ) -> optax.GradientTransformation:
     """Wrap ``tx`` so its state lives sharded along mesh ``axis``.
 
@@ -186,6 +187,15 @@ def sharded(
     ``mean_grads=True`` averages (divides the scattered sum by the axis
     size) — the sync-DP convention; ``False`` sums, matching the
     reference's gradient-push accumulation semantics.
+
+    ``comm`` (ISSUE 9): a :class:`mpit_tpu.train.grad_sync.GradSync`
+    delegating the three communication choreography points — grad
+    reduce-scatter, param shard selection, update all-gather — to the
+    selected wire tier (bucketed Pallas ring / quantized ring). ``None``
+    keeps the stock XLA collectives, byte-for-byte the seed behavior.
+    Every GradSync mode produces the SAME contiguous shard layout as
+    :func:`shard_of`, so optimizer state (and checkpoints) are
+    interchangeable across ``comm`` choices.
     """
 
     def init(params):
@@ -198,21 +208,29 @@ def sharded(
         n = lax.axis_size(axis)
         flat_g, unravel = flat_ravel(grads)
         size = flat_g.shape[0]
-        # reduce-scatter: each device receives the summed shard it owns.
-        # [rows, LANE] view keeps the lowering's minor dim lane-aligned
-        # (see module docstring: the 1-D form tile-pads 16x at 300M+).
-        g2 = _pad_to(flat_g, n * LANE).reshape(-1, LANE)
-        g_shard = C.reduce_scatter(g2, axis).reshape(-1)
+        if comm is None:
+            # reduce-scatter: each device receives the summed shard it
+            # owns. [rows, LANE] view keeps the lowering's minor dim
+            # lane-aligned (see module docstring: the 1-D form
+            # tile-pads 16x at 300M+).
+            g2 = _pad_to(flat_g, n * LANE).reshape(-1, LANE)
+            g_shard = C.reduce_scatter(g2, axis).reshape(-1)
+        else:
+            g_shard = comm.scatter_grads(flat_g)
         if mean_grads:
             g_shard = g_shard / n
         flat_p, _ = flat_ravel(params)
-        p_shard = shard_of(flat_p, axis)
+        p_shard = shard_of(flat_p, axis) if comm is None else comm.param_shard(flat_p)
         u_shard, new_state = tx.update(g_shard, state, p_shard)
-        # invariant gather: updates are identical everywhere and typed
-        # replicated, so they can exit shard_map with a replicated spec.
-        flat_u = C.allgather(
-            u_shard.reshape(-1, LANE), axis, tiled=True, invariant=True
-        ).reshape(-1)[:size]
+        if comm is None:
+            # invariant gather: updates are identical everywhere and
+            # typed replicated, so they can exit shard_map with a
+            # replicated spec.
+            flat_u = C.allgather(
+                u_shard.reshape(-1, LANE), axis, tiled=True, invariant=True
+            ).reshape(-1)[:size]
+        else:
+            flat_u = comm.gather_updates(u_shard, size)
         # Barrier before unravel: without it, XLA's algebraic simplifier
         # rewrites a leaf extraction (1-D slice + reshape to e.g. the MoE
         # router's [768, 8]) into a reshape of the WHOLE flat vector to

@@ -14,6 +14,7 @@ along the data axis and optimizer state sharded across chips.
 - :mod:`mpit_tpu.train.metrics` — step metrics, throughput meters, JSONL.
 """
 
+from mpit_tpu.train.grad_sync import GRAD_SYNC_MODES, GradSync
 from mpit_tpu.train.guard import Diverged, DivergenceGuard
 from mpit_tpu.train.step import TrainState, make_eval_step, make_train_step
 from mpit_tpu.train.loop import Trainer, hardened_loop
@@ -34,6 +35,8 @@ from mpit_tpu.train.convert import (
 from mpit_tpu.train.metrics import MetricLogger, Throughput
 
 __all__ = [
+    "GRAD_SYNC_MODES",
+    "GradSync",
     "Diverged",
     "DivergenceGuard",
     "TrainState",

@@ -257,6 +257,12 @@ def hardened_loop(
     compile_watch = obs.roofline.CompileWatch(
         expected=1, scope="train_step", sentinel=sentinel
     )
+    # Executed grad-sync mode stamp (ISSUE 9 satellite): label the step
+    # spans the way serve stamps ``attention=`` — "ring" off-TPU runs
+    # the fallback, and bench/traces must attribute that honestly. The
+    # default psum mode stays unlabeled (spans byte-identical to seed).
+    gs_mode = getattr(step_fn, "grad_sync_mode", None)
+    step_attrs = {"grad_sync": gs_mode} if gs_mode and gs_mode != "psum" else {}
     pending: deque[_MetricFetch] = deque()
     last_eval: dict | None = None
     tracing = False
@@ -415,7 +421,7 @@ def hardened_loop(
                         except Exception:
                             pass  # cost support is best-effort telemetry
                     step_t0 = time.perf_counter()
-                    with obs.span("step"):
+                    with obs.span("step", **step_attrs):
                         state, metrics = compile_watch.call(
                             "step", step_fn, state, batch
                         )

@@ -109,6 +109,9 @@ def make_train_step(
     stateful: bool = False,
     donate: bool = True,
     scan_steps: int | None = None,
+    grad_sync: str = "psum",
+    grad_bucket_mb: float = 4.0,
+    grad_sync_interpret: bool | None = None,
 ):
     """Build ``(init_fn, step_fn, state_specs)`` for SPMD data-parallel
     training over ``world``'s ``axis``.
@@ -124,6 +127,19 @@ def make_train_step(
       zero1: shard optimizer state across ``axis`` (reduce-scatter/
         all-gather path); False = replicated state + plain pmean DP.
       donate: donate the input state buffers to the step (in-place update).
+      grad_sync: the gradient-sync wire tier (ISSUE 9;
+        ``train/grad_sync.py``): ``"psum"`` (default) keeps the stock
+        XLA collectives byte-for-byte; ``"ring"`` issues the in-kernel
+        Pallas ring reduce-scatter/all-gather per fixed-size gradient
+        bucket (numerically identical to psum — pinned); ``"ring_q8"``
+        adds the EQuARX-spirit int8 wire with per-chunk scales (~¼ the
+        wire bytes; lossy — the MNIST/AlexNet loss-curve pin is the
+        contract). Off-TPU the ring modes fall back to the exact
+        ``lax`` composition, and the EXECUTED mode is stamped on the
+        loop's step spans as ``grad_sync=`` (the way serve stamps
+        ``attention=``), exposed here as ``step_fn.grad_sync_mode``.
+      grad_bucket_mb / grad_sync_interpret: bucket size and interpret-
+        mode flag for the ring tiers (see ``GradSync``).
       scan_steps: when set, ``step_fn`` consumes a *stacked* batch (every
         leaf carries a leading ``[scan_steps, ...]`` axis) and runs that
         many optimizer steps inside one compiled call via ``lax.scan`` —
@@ -138,8 +154,25 @@ def make_train_step(
       ``step_fn(state, sharded_batch) -> (state, metrics)`` (jitted),
       ``state_specs(params, extra=()) -> TrainState`` of PartitionSpecs.
     """
+    from mpit_tpu.train.grad_sync import GradSync
+
+    gs = (
+        grad_sync
+        if isinstance(grad_sync, GradSync)
+        else GradSync(
+            axis, grad_sync, bucket_mb=grad_bucket_mb,
+            interpret=grad_sync_interpret,
+        )
+    )
+    # psum mode passes stx=None so zero1_state_fns builds the seed
+    # gopt.sharded(tx, axis) — byte-for-byte the pre-ISSUE-9 path.
+    ring_stx = (
+        gopt.sharded(tx, axis, comm=gs)
+        if zero1 and gs.mode != "psum"
+        else None
+    )
     stx, state_specs, init_fn = zero1_state_fns(
-        tx, world, axis=axis, zero1=zero1
+        tx, world, axis=axis, zero1=zero1, stx=ring_stx
     )
 
     def _per_device_step(state: TrainState, batch):
@@ -168,7 +201,9 @@ def make_train_step(
             # inside (mean semantics — stx was built with mean_grads=True).
             updates, opt_state = stx.update(grads, state.opt_state, state.params)
         else:
-            grads = jax.tree.map(lambda g: lax.pmean(g, axis), grads)
+            # Plain-DP sync — GradSync's pluggable wire (psum mode IS
+            # the seed lax.pmean, the ring modes flatten + bucket).
+            grads = gs.allreduce_grads(grads)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
 
@@ -225,6 +260,9 @@ def make_train_step(
         return sum(f._cache_size() for f in compiled.values())
 
     step_fn._cache_size = _cache_size
+    # Executed-mode stamp (ISSUE 9 satellite): hardened_loop attaches
+    # this to its step spans so traces attribute fallback runs honestly.
+    step_fn.grad_sync_mode = gs.exec_mode
     return init_fn, step_fn, state_specs
 
 

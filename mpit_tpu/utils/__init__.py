@@ -22,7 +22,9 @@ from mpit_tpu.utils.profiling import (
     allreduce_gbps,
     collective_bytes,
     compiled_cost,
+    modeled_all_gather_seconds,
     modeled_allreduce_seconds,
+    modeled_reduce_scatter_seconds,
     roofline,
     scaling_projection,
     trace,
@@ -43,7 +45,9 @@ __all__ = [
     "allreduce_gbps",
     "collective_bytes",
     "compiled_cost",
+    "modeled_all_gather_seconds",
     "modeled_allreduce_seconds",
+    "modeled_reduce_scatter_seconds",
     "roofline",
     "scaling_projection",
     "trace",

@@ -235,6 +235,12 @@ def modeled_allreduce_seconds(
     with payload (small payloads are latency-bound, large ones approach
     the bandwidth ceiling) instead of the constant a latency-free model
     produces. Modeled, not measured — label it.
+
+    Identity (pinned in tests): this equals
+    ``modeled_reduce_scatter_seconds + modeled_all_gather_seconds`` at
+    the same payload — the allreduce IS that composition (ISSUE 9), so
+    the factored collectives reconcile against a model of the right
+    shape instead of half an allreduce hand-wave.
     """
     p = num_devices
     if p <= 1:
@@ -242,6 +248,43 @@ def modeled_allreduce_seconds(
     wire = collective_bytes(payload_bytes, p, "allreduce")
     return 2.0 * (p - 1) * chip.ici_hop_latency + wire / (
         2.0 * chip.ici_bandwidth
+    )
+
+
+def _modeled_phase_seconds(
+    payload_bytes: float, num_devices: int, op: str, chip: ChipSpec
+) -> float:
+    """One ring phase: ``P−1`` hops of latency + ``(P−1)/P·N`` wire at
+    both-directions ICI bandwidth (every chip sends and receives
+    simultaneously on a ring — the same assumption the allreduce model
+    makes, so the phases sum EXACTLY to it)."""
+    p = num_devices
+    if p <= 1:
+        return 0.0
+    wire = collective_bytes(payload_bytes, p, op)
+    return (p - 1) * chip.ici_hop_latency + wire / (2.0 * chip.ici_bandwidth)
+
+
+def modeled_reduce_scatter_seconds(
+    payload_bytes: float, num_devices: int, *, chip: ChipSpec = TPU_V5E
+) -> float:
+    """Ring reduce-scatter time model (ISSUE 9 satellite): the
+    payload-sized model the factored ``ring_reduce_scatter`` reconciles
+    against. ``payload_bytes`` is the bytes ON THE WIRE — quantized
+    callers pass the int8-sized payload (``RingPlan.wire_payload_bytes``),
+    never the logical one. Modeled, not measured — label it."""
+    return _modeled_phase_seconds(
+        payload_bytes, num_devices, "reduce_scatter", chip
+    )
+
+
+def modeled_all_gather_seconds(
+    payload_bytes: float, num_devices: int, *, chip: ChipSpec = TPU_V5E
+) -> float:
+    """Ring all-gather time model — the other half of the allreduce
+    composition (see :func:`modeled_reduce_scatter_seconds`)."""
+    return _modeled_phase_seconds(
+        payload_bytes, num_devices, "all_gather", chip
     )
 
 
@@ -397,22 +440,32 @@ class CommModel:
         *,
         zero1: bool = True,
         num_slices: int = 1,
+        wire_scale: float = 1.0,
     ):
         if num_slices > 1 and num_devices % num_slices:
             raise ValueError(
                 f"{num_devices} devices not divisible into {num_slices} slices"
             )
+        if wire_scale <= 0:
+            raise ValueError(f"wire_scale must be positive, got {wire_scale}")
         self.param_bytes = tree_bytes(params)
         self.num_devices = num_devices
         self.zero1 = zero1
         self.num_slices = num_slices if num_devices > 1 else 1
+        # Bytes-on-wire per logical payload byte (ISSUE 9): a quantized
+        # gradient sync (grad_sync="ring_q8") ships int8 chunks — ¼ of
+        # an f32 payload — and the modeled ICI accounting (roofline
+        # attribution, P2P matrix reconciliation) must see the ACTUAL
+        # wire size, not the logical one. GradSync.wire_scale() is the
+        # matching source of this factor.
+        self.wire_scale = float(wire_scale)
 
     def _phase_bytes(self, payload: float, p: int) -> float:
         """Per-chip wire bytes to allreduce ``payload`` over ``p`` ranks
         (2·(P−1)/P·N: ZeRO-1's RS+AG and the plain allreduce move the
         same total — they differ in where the optimizer runs, not in
-        bytes)."""
-        return collective_bytes(payload, p, "allreduce")
+        bytes), at the wire-scaled (possibly quantized) size."""
+        return collective_bytes(payload * self.wire_scale, p, "allreduce")
 
     def grad_sync_bytes(self) -> float:
         """Total per-chip wire bytes (both phases; ICI + DCN)."""

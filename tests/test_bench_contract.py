@@ -281,14 +281,24 @@ def _realistic_results():
         },
         "allreduce": {
             "gbps": 50.88,
+            # ISSUE 9: the ring + quantized-ring figures join the line
+            # (modeled off-TPU, like gbps — one `modeled` flag labels
+            # all three); the per-payload three-variant curve and the
+            # q8 wire-size bookkeeping stay detail-only.
+            "ring_gbps": 50.88,
+            "q8_gbps": 186.18,
             "modeled": True,
+            "platform": "cpu",
             "devices": 8,
             "payload_mb": 64,
-            "by_payload_mb": {"1": 30.49, "4": 43.3, "16": 48.78,
-                              "64": 50.88, "256": 51.29},
+            "by_payload_mb": {
+                mb: {"psum": 50.88, "ring": 50.88, "q8": 186.18}
+                for mb in ("1", "4", "16", "64", "256")
+            },
+            "q8_wire_bytes_at_payload": 16810304,
             "ici_hop_latency_us_assumed": 1.0,
-            "note": "1 device: no-op collective; latency-aware ICI ring "
-                    "estimate for 8 chips",
+            "note": "1 device(s) on cpu: latency-aware ICI ring "
+                    "estimate for 8 chips; no GB/s measured off-TPU",
             "phases": phases,
             "obs_baseline": obs_baseline,
         },
@@ -338,10 +348,25 @@ class TestLineBudget:
         # The gpt2_moe scaling block is back (ISSUE 3 satellite) and
         # stays detail-file-only, like every other bulky blob.
         assert "scaling" not in rec["detail"]["gpt2_moe"]
-        # The modeled allreduce figure is payload-sized now; the line
-        # carries gbps + modeled only — the payload curve is detail-only.
-        assert rec["detail"]["allreduce"]["modeled"] is True
-        assert "by_payload_mb" not in rec["detail"]["allreduce"]
+        # The modeled allreduce figure is payload-sized, and the ring /
+        # quantized-ring records ride the line next to it (ISSUE 9);
+        # the three-variant payload curve and the q8 wire-size
+        # bookkeeping are detail-only.
+        ar = rec["detail"]["allreduce"]
+        assert ar["modeled"] is True
+        assert ar["ring_gbps"] == 50.88
+        assert ar["q8_gbps"] == 186.18
+        assert "by_payload_mb" not in ar
+        assert "q8_wire_bytes_at_payload" not in ar
+        assert "platform" not in ar
+        # Paid for by static config echo moving detail-only: the
+        # allreduce devices (== the record's top-level detail.devices),
+        # resnet50's global_batch and gpt2's seq_len (fixed geometry,
+        # in BENCH_DETAIL.json verbatim).
+        assert "devices" not in ar
+        assert "global_batch" not in rec["detail"]["resnet50"]
+        assert "seq_len" not in rec["detail"]["gpt2"]
+        assert rec["detail"]["devices"] == 8
         # The serving workload (ISSUE 4): decode tokens/s + request
         # latency p50/p95 ride the line — joined by the resolved
         # decode-attention mode (ISSUE 5: kernel vs reference fallback
