@@ -30,6 +30,12 @@ class TrainConfig:
       ``nranks-1`` client ranks exchanging tagged messages on the
       :mod:`mpit_tpu.compat` simulator (the ``mpirun -n P`` analogue), for
       semantics/parity work, not performance.
+    - ``"elastic"``: the robustness tier (ISSUE 11; ``train/elastic.py``)
+      — 1 anchor server + ``nranks-1`` replicas each running the async
+      ``hardened_loop`` with EASGD anchor exchanges, heartbeat/lease
+      liveness, divergence quarantine, and crash/rejoin recovery over
+      per-replica crash-consistent checkpoints (``--ckpt-dir`` enables
+      them; ``--ckpt-every`` sets the cadence).
     """
 
     mode: str = "spmd"  # spmd | parity
@@ -64,8 +70,19 @@ class TrainConfig:
     grad_bucket_mb: float = 4.0  # ring tiers: bucket size (MB of f32)
     easgd: bool = False  # elastic-averaging dynamics instead of Downpour
     easgd_alpha: float = 0.125
+    # Elastic tier (mode=elastic): alpha = easgd_beta / N_active when
+    # easgd_beta > 0 (the paper's β = N·α spelling — eviction gracefully
+    # reshapes the denominator); 0 keeps the fixed easgd_alpha coupling.
+    easgd_beta: float = 0.0
     sync_every: int = 1  # parity mode: client steps between server exchanges
-    nranks: int = 2  # parity mode: 1 pserver + (nranks-1) pclients
+    nranks: int = 2  # parity/elastic: 1 server + (nranks-1) clients/replicas
+    # Elastic-tier liveness/staleness knobs (train/elastic.py): a
+    # replica silent past lease_s is evicted from the averaging
+    # denominator; an anchor pull more than staleness_bound center
+    # versions stale is flagged (anchor_staleness_exceeded), not fatal.
+    lease_s: float = 1.0
+    heartbeat_s: float = 0.1
+    staleness_bound: int = 8
     mesh: str = ""  # SPMD mesh, e.g. "data=4,model=2"; "" = all-data
     native: bool = False  # C++ data-pipeline core (falls back if unbuilt)
     data_dir: str = ""  # on-disk dataset (data/filedata.py); "" = synthetic

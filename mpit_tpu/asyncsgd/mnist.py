@@ -30,6 +30,9 @@ from mpit_tpu.models import LeNet
 
 
 def main(argv: list[str] | None = None, **overrides) -> dict:
+    # Not a config field: a programmatic FaultPlan for the elastic mode
+    # (bench's seeded straggler/kill scenarios ride through here).
+    fault_plan = overrides.pop("fault_plan", None)
     cfg = from_argv(TrainConfig, argv, prog="asyncsgd.mnist", overrides=overrides)
     print(runner.describe(cfg, "mnist-lenet"))
     dataset = runner.classification_dataset(
@@ -45,6 +48,12 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
 
     if cfg.mode == "parity":
         return runner.run_parity_classifier(cfg, model, dataset)
+    if cfg.mode == "elastic":
+        # The robustness tier (ISSUE 11): anchor server + N replicas on
+        # hardened_loop with heartbeat/lease, quarantine, crash/rejoin.
+        return runner.run_elastic_classifier(
+            cfg, model, dataset, fault_plan=fault_plan
+        )
 
     def init_params():
         params = model.init(

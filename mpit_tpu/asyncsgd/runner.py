@@ -578,6 +578,124 @@ def run_parity_classifier(cfg: TrainConfig, model, dataset) -> dict:
     }
 
 
+def run_elastic_classifier(
+    cfg: TrainConfig, model, dataset, *, fault_plan=None, sentinel=None
+) -> dict:
+    """The robustness tier (ISSUE 11; ``train/elastic.py``): 1 anchor
+    server + N replicas, each running the production async
+    ``hardened_loop`` with EASGD anchor exchanges every
+    ``cfg.sync_every`` local steps, heartbeat/lease liveness, divergence
+    quarantine, and (with ``--ckpt-dir``) crash-consistent per-replica
+    checkpoints for crash/rejoin recovery.
+
+    ``fault_plan`` (:class:`mpit_tpu.compat.FaultPlan`) injects seeded,
+    reproducible faults — the bench straggler/kill scenarios drive this
+    directly. Returns the final-center eval next to per-replica stats.
+    """
+    import mpit_tpu
+    from mpit_tpu.train import ElasticConfig, TrainState, run_elastic
+
+    world = mpit_tpu.init(cfg.mesh_shape())
+    nreplicas = max(cfg.nranks - 1, 1)
+    sample = dataset.eval_batch(1)
+    params0 = model.init(
+        jax.random.key(cfg.seed), jnp.zeros_like(jnp.asarray(sample["image"]))
+    )["params"]
+    flat0, unravel = jax.flatten_util.ravel_pytree(params0)
+    flat0 = jnp.asarray(flat0, jnp.float32)
+
+    local_tx = gopt.goo(
+        gopt.schedules.from_config(cfg), cfg.momentum,
+        weight_decay=cfg.weight_decay,
+    )
+
+    def init_state():
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=flat0,
+            opt_state=local_tx.init(flat0),
+            extra=(),
+        )
+
+    @jax.jit
+    def step_fn(state, batch):
+        def f(fl):
+            logits = model.apply({"params": unravel(fl)}, batch["image"])
+            return softmax_xent(logits, batch["label"])
+
+        loss, g = jax.value_and_grad(f)(state.params)
+        updates, opt_state = local_tx.update(g, state.opt_state, state.params)
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=optax.apply_updates(state.params, updates),
+                opt_state=opt_state,
+                extra=(),
+            ),
+            {"loss": loss},
+        )
+
+    steps_per_replica = max(cfg.steps // nreplicas, 1)
+    per_replica_batch = max(cfg.batch_size // nreplicas, 1)
+
+    def stream_factory(ridx: int, skip: int):
+        return dataset.batches(
+            per_replica_batch, seed=cfg.seed + 100 + ridx, skip=skip
+        )
+
+    ecfg = ElasticConfig(
+        replicas=nreplicas,
+        steps=steps_per_replica,
+        sync_every=max(cfg.sync_every, 1),
+        alpha=cfg.easgd_alpha,
+        beta=cfg.easgd_beta,
+        staleness_bound=cfg.staleness_bound,
+        heartbeat_s=cfg.heartbeat_s,
+        lease_s=cfg.lease_s,
+        ckpt_dir=cfg.ckpt_dir,
+        ckpt_every=cfg.ckpt_every,
+        max_restores=cfg.max_restores,
+        log_every=cfg.log_every,
+        fetch_lag=cfg.fetch_lag,
+    )
+    out = run_elastic(
+        world, ecfg, init_state, step_fn, stream_factory,
+        fault_plan=fault_plan,
+        sentinel=sentinel if sentinel is not None else _make_sentinel(cfg),
+        items_per_batch=per_replica_batch,
+    )
+
+    # Final-model eval with the anchor's canonical center (the pserver's
+    # final params, exactly as the parity path evaluates).
+    center = out["center"]
+    eval_b = dataset.eval_batch(cfg.eval_batch)
+    logits = model.apply(
+        {"params": unravel(jnp.asarray(center))}, jnp.asarray(eval_b["image"])
+    )
+    result = {
+        "mode": "elastic",
+        "protocol": "easgd",
+        "replicas": nreplicas,
+        "steps_per_replica": steps_per_replica,
+        "anchor_version": out["version"],
+        "server": {k: v for k, v in out["server"].items() if k != "center"},
+        "replica_stats": [
+            {k: v for k, v in r.items() if k != "losses"}
+            for r in out["replicas"]
+        ],
+        "losses": out["replicas"][0]["losses"],
+        "final_loss": out["replicas"][0]["final_loss"],
+        "eval": {
+            "accuracy": float(accuracy(logits, jnp.asarray(eval_b["label"]))),
+            "loss": float(softmax_xent(logits, jnp.asarray(eval_b["label"]))),
+        },
+    }
+    for key in ("flight", "fault_events", "sentinel"):
+        if key in out:
+            result[key] = out[key]
+    return result
+
+
 def describe(cfg: TrainConfig, workload: str) -> str:
     fields = ", ".join(
         f"{f.name}={getattr(cfg, f.name)!r}" for f in dataclasses.fields(cfg)

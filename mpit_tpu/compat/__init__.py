@@ -40,10 +40,19 @@ Usage (the ``mpirun -n 4 th script.lua`` analogue)::
     mpiT.run(main, nranks=4)
 """
 
+from mpit_tpu.compat.faults import (  # noqa: F401
+    FaultPlan,
+    MessageRule,
+    ReplicaKilled,
+    Slowdown,
+    StepAction,
+)
 from mpit_tpu.compat.simulator import (  # noqa: F401
     ANY_SOURCE,
     AbortedError,
     ANY_TAG,
+    CompatTimeoutError,
+    bind_thread,
     BYTE,
     CHAR,
     COMM_WORLD,

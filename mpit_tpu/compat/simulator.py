@@ -108,6 +108,30 @@ class AbortedError(RuntimeError):
     (another rank died) — the analogue of mpirun killing the job."""
 
 
+class CompatTimeoutError(TimeoutError):
+    """A blocking compat call (``Recv``/``Wait``/``Probe``) exceeded its
+    ``timeout=`` — the structured alternative to blocking forever on a
+    dead or wedged peer (ISSUE 11 satellite). Carries the waiting rank,
+    the operation, and the ``(src, tag)`` envelope it was matching, so a
+    tier-1 hang becomes a diagnosable assertion instead of a stuck
+    process. ``ANY_SOURCE``/``ANY_TAG`` render as ``"any"``."""
+
+    def __init__(self, *, op: str, rank: int, src: int, tag: int, timeout: float):
+        def _w(v: int) -> str:
+            return "any" if v in (ANY_SOURCE, ANY_TAG) else str(v)
+
+        super().__init__(
+            f"{op} on rank {rank} timed out after {timeout}s waiting for "
+            f"src={_w(src)} tag={_w(tag)} (peer dead, message dropped, or "
+            "deadlock)"
+        )
+        self.op = op
+        self.rank = rank
+        self.src = src
+        self.tag = tag
+        self.timeout = timeout
+
+
 class Request:
     """The ``MPI_Request`` analogue returned by ``Isend``/``Irecv``.
 
@@ -170,11 +194,19 @@ class Request:
             rec.add_counter("p2p_recv_bytes", flat.nbytes, attrs)
             rec.add_counter("p2p_recv_msgs", 1, attrs)
 
-    def wait(self) -> Status | None:
-        """Block until complete — ``mpiT.Wait`` analogue."""
+    def wait(self, timeout: float | None = None) -> Status | None:
+        """Block until complete — ``mpiT.Wait`` analogue. With
+        ``timeout`` (seconds), raise :class:`CompatTimeoutError` instead
+        of blocking forever; the request stays posted and a later
+        ``wait``/``test`` can still complete it (retry-with-backoff is
+        built on exactly that)."""
         if not self._done:
             assert self._rank is not None
-            self._comm._boxes[self._rank].wait_request(self)
+            if not self._comm._boxes[self._rank].wait_request(self, timeout):
+                raise CompatTimeoutError(
+                    op="Wait", rank=self._rank, src=self._src,
+                    tag=self._tag, timeout=timeout,
+                )
         return self.status
 
     def test(self) -> bool:
@@ -230,11 +262,23 @@ class _Mailbox:
                     return
             self._posted.append(req)
 
-    def wait_request(self, req: Request) -> None:
+    def wait_request(self, req: Request, timeout: float | None = None) -> bool:
+        """Block until ``req`` completes; ``False`` on timeout (the
+        request stays posted — the caller may retry or give up)."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         with self._cond:
             while not req._done:
                 self._check_abort()
-                self._cond.wait()
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    self._cond.wait(remaining)
+        return True
 
     def test_request(self, req: Request) -> bool:
         with self._cond:
@@ -242,9 +286,21 @@ class _Mailbox:
                 self._check_abort()
             return req._done
 
-    def peek(self, src: int, tag: int, *, block: bool = True) -> _Message | None:
+    def peek(
+        self,
+        src: int,
+        tag: int,
+        *,
+        block: bool = True,
+        timeout: float | None = None,
+    ) -> _Message | None:
         """Probe: wait for (or poll) a matching unexpected message without
-        consuming it."""
+        consuming it. ``timeout`` bounds the blocking wait (``None`` on
+        expiry — the caller raises the structured error with its own
+        envelope context)."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         with self._cond:
             while True:
                 self._check_abort()
@@ -253,7 +309,29 @@ class _Mailbox:
                         return m
                 if not block:
                     return None
-                self._cond.wait()
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._cond.wait(remaining)
+
+    def describe(self) -> dict:
+        """Diagnostic snapshot for the deadlock watchdog: what this rank
+        is holding (unmatched arrived messages) and waiting for (posted
+        receives) — the state a hung job's dump needs to name the cycle."""
+        with self._cond:
+            return {
+                "pending": [
+                    {"src": m.src, "tag": m.tag, "count": int(np.asarray(m.data).size)}
+                    for m in self._pending
+                ],
+                "posted": [
+                    {"src": r._src, "tag": r._tag} for r in self._posted
+                ],
+                "aborted": self._aborted,
+            }
 
     def abort(self) -> None:
         with self._cond:
@@ -278,6 +356,24 @@ class Comm:
         self._dup_lock = threading.Lock()
         self._dups: dict[str, "Comm"] = {}
         self._aborted = False
+        # Installed fault plan (compat.faults.FaultPlan) — consulted by
+        # Send; dups inherit it so library channels see the same wire.
+        self._fault_plan = None
+
+    def describe(self) -> dict:
+        """Per-rank mailbox state of this communicator AND its dups —
+        the deadlock watchdog's dump (ISSUE 11 satellite): when a job
+        times out, this names who is parked on what instead of leaving
+        a silent hang."""
+        with self._dup_lock:
+            dups = dict(self._dups)
+        out = {
+            "comm": self.name,
+            "ranks": {r: box.describe() for r, box in enumerate(self._boxes)},
+        }
+        if dups:
+            out["dups"] = {k: d.describe() for k, d in sorted(dups.items())}
+        return out
 
     # -- collective rendezvous ------------------------------------------------
     def abort(self) -> None:
@@ -416,6 +512,7 @@ def Comm_dup(comm: Comm | None = None, *, key: str = "dup") -> Comm:
         d = c._dups.get(key)
         if d is None:
             d = c._dups[key] = Comm(c.size, name=f"{c.name}.{key}")
+            d._fault_plan = c._fault_plan  # same wire, same faults
             if c._aborted:
                 # Parent died before this dup existed: the dup is born
                 # aborted, so a survivor blocking on it gets the
@@ -442,7 +539,30 @@ def Send(buf, dest: int, tag: int = 0, comm: Comm | None = None) -> None:
         # byte matrix for parity runs (obs.traffic_matrix) reads these.
         _obs.counter("p2p_send_bytes", data.nbytes, src=rank, dst=dest)
         _obs.counter("p2p_send_msgs", 1, src=rank, dst=dest)
-    c._boxes[dest].put(_Message(rank, tag, data))
+    msg = _Message(rank, tag, data)
+    plan = c._fault_plan
+    if plan is not None:
+        # Fault injection (ISSUE 11; compat/faults.py): the installed
+        # plan may drop this message or deliver it late. Decisions are
+        # logged on the plan; send-side obs counters above already ran —
+        # the wire ATTEMPT is what send accounting means, and a matrix
+        # reconciliation under faults is expected to disagree by exactly
+        # the dropped bytes.
+        fault = plan.message_fault(rank, dest, tag)
+        if fault is not None:
+            kind, delay_s = fault
+            if kind == "drop":
+                if _obs.enabled():
+                    _obs.instant(
+                        "message_dropped", src=rank, dst=dest, tag=tag
+                    )
+                return
+            box = c._boxes[dest]
+            t = threading.Timer(delay_s, box.put, args=(msg,))
+            t.daemon = True
+            t.start()
+            return
+    c._boxes[dest].put(msg)
 
 
 def Recv(
@@ -450,6 +570,8 @@ def Recv(
     src: int = ANY_SOURCE,
     tag: int = ANY_TAG,
     comm: Comm | None = None,
+    *,
+    timeout: float | None = None,
 ) -> Status:
     """Blocking tagged receive into ``buf`` — ``mpiT.Recv``. Returns Status
     (where the reference surfaced source/tag via MPI_Status for the
@@ -457,10 +579,33 @@ def Recv(
 
     Implemented as post-then-wait, so it takes its place in the
     posted-receive queue *after* any outstanding Irecvs — MPI's matching
-    order.
+    order. ``timeout`` (seconds, ISSUE 11 satellite) converts a would-be
+    forever-block on a dead peer into a structured
+    :class:`CompatTimeoutError` naming the rank and the ``(src, tag)``
+    envelope; on timeout the posted receive is WITHDRAWN (a message
+    arriving later goes to the unexpected queue, not into a buffer the
+    caller has moved on from).
     """
     req = Irecv(buf, src, tag, comm)
-    st = req.wait()
+    try:
+        st = req.wait(timeout)
+    except CompatTimeoutError:
+        # Withdraw the posted receive under the mailbox lock; the race
+        # where the message lands between the timeout and the withdrawal
+        # resolves to successful delivery (checked below).
+        rank, _ = _require_ctx()
+        c = _resolve(comm)
+        box = c._boxes[rank]
+        with box._cond:
+            if not req._done:
+                try:
+                    box._posted.remove(req)
+                except ValueError:
+                    pass
+                raise CompatTimeoutError(
+                    op="Recv", rank=rank, src=src, tag=tag, timeout=timeout
+                ) from None
+        st = req.status
     assert st is not None
     return st
 
@@ -493,9 +638,11 @@ def Irecv(
     return req
 
 
-def Wait(req: Request) -> Status | None:
-    """``mpiT.Wait``."""
-    return req.wait()
+def Wait(req: Request, *, timeout: float | None = None) -> Status | None:
+    """``mpiT.Wait``. With ``timeout`` raises
+    :class:`CompatTimeoutError` instead of blocking forever (the request
+    stays posted — retry by calling ``Wait`` again)."""
+    return req.wait(timeout)
 
 
 def Waitall(reqs: Sequence[Request]) -> list[Status | None]:
@@ -508,15 +655,41 @@ def Test(req: Request) -> bool:
 
 
 def Probe(
-    src: int = ANY_SOURCE, tag: int = ANY_TAG, comm: Comm | None = None
+    src: int = ANY_SOURCE,
+    tag: int = ANY_TAG,
+    comm: Comm | None = None,
+    *,
+    timeout: float | None = None,
 ) -> Status:
     """Blocking probe — ``mpiT.Probe``: Status of the next matching message
-    without consuming it (the server loop's peek-then-dispatch tool)."""
+    without consuming it (the server loop's peek-then-dispatch tool).
+    ``timeout`` raises :class:`CompatTimeoutError` on expiry — the
+    anchor server's lease sweep runs off exactly this (probe with a
+    bounded wait, service liveness on the timeout path)."""
     rank, _ = _require_ctx()
     c = _resolve(comm)
-    msg = c._boxes[rank].peek(src, tag, block=True)
-    assert msg is not None
+    msg = c._boxes[rank].peek(src, tag, block=True, timeout=timeout)
+    if msg is None:
+        raise CompatTimeoutError(
+            op="Probe", rank=rank, src=src, tag=tag, timeout=timeout
+        )
     return Status(source=msg.src, tag=msg.tag, count=msg.data.size)
+
+
+def bind_thread(rank: int, comm: Comm) -> None:
+    """Adopt ``rank``'s identity on the CALLING thread.
+
+    The simulator's rank context is thread-local (each rank of a
+    :func:`run` job is one thread). A library helper thread a rank
+    spawns — the elastic tier's heartbeat sender — has no context and
+    would otherwise Send as a world-of-one rank 0. Binding gives it the
+    owning rank's identity on the SAME communicator; the thread may then
+    use the full P2P surface. Collectives still belong to the rank's
+    main thread (two threads of one rank entering a barrier would
+    deadlock it)."""
+    _ctx.rank = rank
+    _ctx.comm = comm
+    _ctx.initialized = True
 
 
 # -- collectives -------------------------------------------------------------
@@ -604,6 +777,7 @@ def run(
     *,
     pass_rank: bool = False,
     timeout: float | None = 120.0,
+    fault_plan=None,
 ) -> list[Any]:
     """Run ``fn`` on ``nranks`` simulated ranks — the ``mpirun -n P`` analogue.
 
@@ -612,11 +786,20 @@ def run(
     rank if ``pass_rank``. Returns each rank's return value, rank-ordered.
     Exceptions on any rank abort the whole "job" (as a dead rank aborts an
     ``mpirun`` job) and the root-cause error re-raises on the caller.
-    ``timeout`` bounds the *total* job wall-clock.
+    ``timeout`` bounds the *total* job wall-clock; a timeout dumps every
+    rank's mailbox state (pending/posted per rank, dups included) to
+    stderr before aborting — the deadlock watchdog (ISSUE 11 satellite):
+    a hung job names who was parked on what.
+
+    ``fault_plan`` (:class:`mpit_tpu.compat.faults.FaultPlan`) installs
+    seeded message faults on the job's wire — ``Send`` consults it (and
+    every ``Comm_dup`` inherits it); step-level faults are the training
+    wrapper's to apply via ``plan.step_action``.
     """
     import time
 
     world = Comm(nranks, name="world")
+    world._fault_plan = fault_plan
     results: list[Any] = [None] * nranks
     errors: list[BaseException | None] = [None] * nranks
 
@@ -647,6 +830,17 @@ def run(
             None if deadline is None else max(0.0, deadline - time.monotonic())
         )
         if t.is_alive():
+            if not timed_out:
+                # Deadlock watchdog dump BEFORE the abort wipes the
+                # evidence: which rank holds/awaits what.
+                import json as _json
+                import sys as _sys
+
+                print(
+                    "[compat] job timeout — per-rank mailbox state:\n"
+                    + _json.dumps(world.describe(), indent=1, default=str),
+                    file=_sys.stderr,
+                )
             timed_out = True
             world.abort()
 

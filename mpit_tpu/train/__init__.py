@@ -18,7 +18,14 @@ from mpit_tpu.train.grad_sync import GRAD_SYNC_MODES, GradSync
 from mpit_tpu.train.guard import Diverged, DivergenceGuard
 from mpit_tpu.train.step import TrainState, make_eval_step, make_train_step
 from mpit_tpu.train.loop import Trainer, hardened_loop
-from mpit_tpu.train.checkpoint import CheckpointManager
+from mpit_tpu.train.checkpoint import AtomicCheckpoint, CheckpointManager
+from mpit_tpu.train.elastic import (
+    AnchorClient,
+    AnchorTimeoutError,
+    ElasticConfig,
+    anchor_server,
+    run_elastic,
+)
 from mpit_tpu.train.convert import (
     DenseState,
     cptp_from_dense,
@@ -44,7 +51,13 @@ __all__ = [
     "make_eval_step",
     "Trainer",
     "hardened_loop",
+    "AnchorClient",
+    "AnchorTimeoutError",
+    "AtomicCheckpoint",
     "CheckpointManager",
+    "ElasticConfig",
+    "anchor_server",
+    "run_elastic",
     "DenseState",
     "dense_from_dp",
     "dp_from_dense",

@@ -1,0 +1,793 @@
+"""Elastic asynchronous EASGD multi-replica tier (ISSUE 11 tentpole).
+
+The reference's defining capability — a pserver/pclient fleet that keeps
+training through slow and dying workers (Zhang–Choromanska–LeCun EASGD,
+arXiv:1412.6651; the MXNET-MPI task-model embedding, arXiv:1801.03855) —
+re-grown on this repo's own layers: N data-parallel replicas each run the
+production async :func:`~mpit_tpu.train.loop.hardened_loop` and exchange
+an elastic-averaging anchor
+
+    replica:  x_i ← x_i − α·(x_i − x̃)
+    anchor:   x̃  ← x̃ + α·(x_i − x̃)
+
+with an **anchor server** actor (grown from ``asyncsgd/actors.py``'s
+pserver loop) over the :mod:`mpit_tpu.compat` layer. Design points:
+
+- **Dedicated channel.** All anchor traffic rides a ``Comm_dup`` of the
+  world communicator (key ``"elastic-anchor"``) — its own matching
+  space, so an application's outstanding wildcard receives can never
+  steal anchor messages (the PR-3 flight-recorder discipline).
+- **Bounded-staleness, per-replica pulls.** Each replica exchanges with
+  the anchor every ``sync_every`` of *its own* steps; the server is
+  asynchronous, so a straggler delays only its own anchor exchange,
+  never the fleet. The server tracks per-replica anchor-version
+  staleness (gauged; past ``staleness_bound`` → an
+  ``anchor_staleness_exceeded`` instant + sentinel note).
+- **Heartbeat + lease liveness.** Each replica runs a heartbeat thread
+  on the anchor channel; the server's probe loop (built on the compat
+  ``timeout=`` satellite) sweeps leases between messages. A silent
+  replica is **evicted** — removed from the averaging denominator
+  (``α = β / N_active`` when ``beta > 0``: graceful N→N−1 degradation)
+  with a ``replica_evicted`` instant; a replica heard from again (a
+  bounded hang, a rejoin after crash-restore) is re-admitted with a
+  ``replica_rejoined`` instant.
+- **Crash / rejoin.** A replica killed mid-run (``FaultPlan.kill_at`` →
+  :class:`~mpit_tpu.compat.faults.ReplicaKilled`) stops heartbeating,
+  gets evicted, then restores from its latest crash-consistent
+  :class:`~mpit_tpu.train.checkpoint.AtomicCheckpoint`, re-registers
+  over ``TAG_REJOIN``, pulls the current anchor, and resumes its
+  ``hardened_loop`` for the remaining steps.
+- **DivergenceGuard quarantine.** Before every push the replica checks
+  its flat params for finiteness: a diverged replica sends
+  ``TAG_QUAR`` (the server drops it from the denominator) instead of
+  poisoning the anchor, then ``hardened_loop``'s existing guard +
+  older-checkpoint restore machinery rolls it back, and the restore
+  event triggers an anchor rejoin + center pull.
+
+The replica's training state is a :class:`~mpit_tpu.train.step.TrainState`
+whose ``params`` leaf is the **flat float32 parameter vector** (the
+pserver protocol's canonical layout, as in the parity actors); the
+jitted local step is supplied by the caller and shared across replicas
+(one compile serves the fleet).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from mpit_tpu import compat as mpiT
+from mpit_tpu.compat.faults import FaultPlan, ReplicaKilled
+from mpit_tpu.obs import core as _obs
+from mpit_tpu.train.checkpoint import AtomicCheckpoint
+from mpit_tpu.train.loop import hardened_loop
+from mpit_tpu.train.metrics import MetricLogger
+
+ANCHOR_CHANNEL = "elastic-anchor"
+SERVER_RANK = 0
+
+# Anchor protocol tags (disjoint from the asyncsgd actors' 11..15 range,
+# though the dedicated Comm_dup already isolates the matching space).
+TAG_REG = 31
+TAG_HB = 32
+TAG_EXCH = 33
+TAG_CENTER = 34
+TAG_QUAR = 35
+TAG_REJOIN = 36
+TAG_STOP = 37
+
+_TAG_NAMES = {TAG_REG: "register", TAG_HB: "heartbeat", TAG_EXCH: "exchange",
+              TAG_CENTER: "center", TAG_QUAR: "quarantine",
+              TAG_REJOIN: "rejoin", TAG_STOP: "stop"}
+
+
+@dataclasses.dataclass
+class ElasticConfig:
+    """Knobs of the elastic tier (CLI surface: ``asyncsgd`` flags).
+
+    ``alpha`` is the per-exchange elastic coupling; when ``beta > 0``
+    the server instead derives ``alpha = beta / N_active`` from the live
+    replica count (the paper's β = N·α stability spelling — eviction
+    then *strengthens* each survivor's coupling, the graceful N→N−1
+    denominator change). ``lease_s`` must comfortably exceed
+    ``heartbeat_s`` (the server warns when it doesn't).
+    """
+
+    replicas: int = 2
+    steps: int = 60  # per-replica local steps
+    sync_every: int = 4
+    alpha: float = 0.125
+    beta: float = 0.0
+    staleness_bound: int = 8
+    heartbeat_s: float = 0.05
+    lease_s: float = 0.5
+    exchange_timeout_s: float = 10.0
+    exchange_retries: int = 3
+    backoff: float = 1.5
+    ckpt_dir: str = ""
+    ckpt_every: int = 0
+    max_restores: int = 2
+    max_to_keep: int = 3
+    log_every: int = 10
+    fetch_lag: int = 2
+    rejoin: bool = True  # a killed replica rejoins from its checkpoint
+
+
+class AnchorTimeoutError(RuntimeError):
+    """The anchor server stayed silent through every retry/backoff round
+    of one client call — the replica's view of a dead anchor."""
+
+
+# ---------------------------------------------------------------------------
+# Server actor.
+# ---------------------------------------------------------------------------
+
+
+class _ReplicaSlot:
+    __slots__ = ("last_hb", "active", "quarantined", "stopped")
+
+    def __init__(self, now: float):
+        self.last_hb = now
+        self.active = True
+        self.quarantined = False
+        self.stopped = False
+
+
+def anchor_server(
+    init_flat: np.ndarray,
+    cfg: ElasticConfig,
+    *,
+    nreplicas: int | None = None,
+    comm=None,
+    sentinel=None,
+) -> dict:
+    """The anchor actor: rank 0 of the elastic job.
+
+    Serves register/exchange/rejoin/stop on the anchor channel until
+    every replica sent ``TAG_STOP``; sweeps heartbeat leases between
+    messages (the probe timeout **is** the liveness clock — no separate
+    timer thread). Returns the final center, version, and the lifecycle
+    event log (``registered`` / ``evicted`` / ``rejoined`` /
+    ``quarantined`` / ``staleness_exceeded`` / ``stopped`` tuples) the
+    tests and bench read.
+    """
+    nreplicas = cfg.replicas if nreplicas is None else nreplicas
+    if cfg.lease_s < 2 * cfg.heartbeat_s:
+        import warnings
+
+        warnings.warn(
+            f"elastic: lease_s={cfg.lease_s} < 2x heartbeat_s="
+            f"{cfg.heartbeat_s} — healthy replicas will flap eviction",
+            stacklevel=2,
+        )
+    ship = mpiT.Comm_dup(comm, key=ANCHOR_CHANNEL)
+    center = np.array(init_flat, np.float32, copy=True)
+    flat_buf = np.empty((center.size + 1,), np.float32)  # [version_seen, *x]
+    ctrl_buf = np.empty((1,), np.int32)
+    version = 0
+    slots: dict[int, _ReplicaSlot] = {}
+    events: list[tuple] = []
+    stops = 0
+    probe_timeout = max(min(cfg.lease_s / 4.0, cfg.heartbeat_s), 0.005)
+
+    def _active_count() -> int:
+        return sum(
+            1 for s in slots.values()
+            if s.active and not s.quarantined and not s.stopped
+        )
+
+    def _alpha() -> float:
+        if cfg.beta > 0.0:
+            return cfg.beta / max(1, _active_count())
+        return cfg.alpha
+
+    _INSTANT_NAMES = {
+        "evicted": "replica_evicted",
+        "rejoined": "replica_rejoined",
+        "quarantined": "replica_quarantined",
+        "staleness_exceeded": "anchor_staleness_exceeded",
+    }
+
+    def _note(kind: str, rank: int, **extra):
+        events.append((kind, rank, *extra.values()))
+        _obs.instant(_INSTANT_NAMES.get(kind, kind), rank=rank, **extra)
+        if sentinel is not None and kind in (
+            "evicted", "staleness_exceeded"
+        ):
+            # Sentinel rule (ISSUE 11 obs wiring): liveness and
+            # staleness breaches land in the run's one anomaly verdict
+            # next to spike/sustained findings; ``clean`` goes false.
+            sentinel.note(kind, "anchor", version, rank=rank, **extra)
+
+    def _gauges():
+        _obs.gauge("active_replicas", _active_count())
+        _obs.gauge("anchor_version", version)
+
+    def _readmit(rank: int, how: str):
+        s = slots[rank]
+        if not s.active or s.quarantined:
+            s.active = True
+            s.quarantined = False
+            _note("rejoined", rank, how=how)
+            _gauges()
+
+    def _sweep(now: float):
+        for rank, s in slots.items():
+            if s.stopped:
+                continue
+            age = now - s.last_hb
+            _obs.gauge("replica_heartbeat_age_s", round(age, 4), rank=rank)
+            if s.active and age > cfg.lease_s:
+                s.active = False
+                _note("evicted", rank, heartbeat_age_s=round(age, 4))
+                _gauges()
+
+    def _reply_center(rank: int):
+        # [version, alpha, *center] — one payload, one Send; the client
+        # applies the SAME alpha the server will use, keeping the pull
+        # symmetric (the paper's coupled update).
+        mpiT.Send(
+            np.concatenate(
+                [np.asarray([version, _alpha()], np.float32), center]
+            ),
+            dest=rank, tag=TAG_CENTER, comm=ship,
+        )
+
+    while stops < nreplicas:
+        try:
+            with _obs.span("anchor:probe_wait"):
+                st = mpiT.Probe(
+                    mpiT.ANY_SOURCE, mpiT.ANY_TAG, comm=ship,
+                    timeout=probe_timeout,
+                )
+        except mpiT.CompatTimeoutError:
+            _sweep(time.monotonic())
+            continue
+        now = time.monotonic()
+        _obs.counter(
+            "anchor_msgs", 1, kind=_TAG_NAMES.get(st.tag, str(st.tag))
+        )
+        if st.tag in (TAG_REG, TAG_REJOIN):
+            mpiT.Recv(ctrl_buf, src=st.source, tag=st.tag, comm=ship)
+            if st.source not in slots:
+                slots[st.source] = _ReplicaSlot(now)
+                events.append(("registered", st.source))
+            else:
+                slots[st.source].last_hb = now
+                slots[st.source].stopped = False
+                _readmit(st.source, how="rejoin")
+            _gauges()
+            _reply_center(st.source)
+        elif st.tag == TAG_HB:
+            mpiT.Recv(ctrl_buf, src=st.source, tag=TAG_HB, comm=ship)
+            s = slots.get(st.source)
+            if s is not None and not s.stopped:
+                s.last_hb = now
+                # A heartbeat from an evicted-but-alive replica (a
+                # bounded hang outlived its lease): readmit — the
+                # replica never knew it was gone. Quarantined replicas
+                # stay out until their explicit rejoin.
+                if not s.active and not s.quarantined:
+                    _readmit(st.source, how="heartbeat")
+        elif st.tag == TAG_EXCH:
+            mpiT.Recv(flat_buf, src=st.source, tag=TAG_EXCH, comm=ship)
+            s = slots.get(st.source)
+            if s is None:
+                s = slots[st.source] = _ReplicaSlot(now)
+                events.append(("registered", st.source))
+            s.last_hb = now
+            if not s.active and not s.quarantined:
+                _readmit(st.source, how="exchange")
+            # Per-replica anchor staleness: how many center updates this
+            # replica missed since its last pull. A straggler's gauge
+            # climbs; past the bound it is an instant + sentinel note —
+            # measured, not fatal (bounded staleness IS the design).
+            staleness = version - int(flat_buf[0])
+            _obs.gauge("replica_staleness", staleness, rank=st.source)
+            if staleness > cfg.staleness_bound:
+                _note(
+                    "staleness_exceeded", st.source, staleness=staleness,
+                    bound=cfg.staleness_bound,
+                )
+            a = _alpha()
+            _reply_center(st.source)
+            with _obs.span("anchor:update"):
+                x_i = flat_buf[1:]
+                center += np.float32(a) * (x_i - center)
+            version += 1
+            _gauges()
+        elif st.tag == TAG_QUAR:
+            mpiT.Recv(ctrl_buf, src=st.source, tag=TAG_QUAR, comm=ship)
+            s = slots.get(st.source)
+            if s is not None:
+                s.quarantined = True
+                s.last_hb = now
+                _note("quarantined", st.source, step=int(ctrl_buf[0]))
+                _gauges()
+        elif st.tag == TAG_STOP:
+            mpiT.Recv(ctrl_buf, src=st.source, tag=TAG_STOP, comm=ship)
+            s = slots.get(st.source)
+            if s is not None:
+                s.stopped = True
+            events.append(("stopped", st.source))
+            stops += 1
+            _gauges()
+        else:  # consume to avoid deadlock, then fail loudly (pserver rule)
+            mpiT.Recv(
+                np.empty((st.count,), np.float32),
+                src=st.source, tag=st.tag, comm=ship,
+            )
+            raise RuntimeError(
+                f"anchor_server: unexpected tag {st.tag} from {st.source}"
+            )
+        _sweep(time.monotonic())
+    return {
+        "center": center,
+        "version": version,
+        "alpha_final": _alpha(),
+        "events": events,
+        "evictions": sum(1 for e in events if e[0] == "evicted"),
+        "rejoins": sum(1 for e in events if e[0] == "rejoined"),
+        "quarantines": sum(1 for e in events if e[0] == "quarantined"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Client proxy (linked into each replica's training loop).
+# ---------------------------------------------------------------------------
+
+
+class AnchorClient:
+    """A replica's anchor proxy: register / exchange / quarantine /
+    rejoin / stop, plus the heartbeat thread.
+
+    Every server round trip posts the reply receive BEFORE sending the
+    request (the reference's Irecv/Isend overlap shape) and waits with
+    the compat ``timeout=`` under retry/backoff — a dead anchor is an
+    :class:`AnchorTimeoutError` naming the call, never a silent hang.
+    """
+
+    def __init__(self, flat_dim: int, cfg: ElasticConfig, *, comm=None):
+        self._cfg = cfg
+        self._ship = mpiT.Comm_dup(comm, key=ANCHOR_CHANNEL)
+        self._rank = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        self._buf = np.empty((flat_dim + 2,), np.float32)  # [ver, alpha, *x̃]
+        self.version = 0
+        self.alpha = cfg.alpha
+        self._hb_stop: threading.Event | None = None
+        self._hb_suspend_until = 0.0
+        self._step = 0
+
+    # -- plumbing ------------------------------------------------------------
+    def _rpc(self, tag: int, payload: np.ndarray, what: str) -> np.ndarray:
+        req = mpiT.Irecv(
+            self._buf, src=SERVER_RANK, tag=TAG_CENTER, comm=self._ship
+        )
+        mpiT.Isend(payload, dest=SERVER_RANK, tag=tag, comm=self._ship)
+        t = self._cfg.exchange_timeout_s
+        for attempt in range(self._cfg.exchange_retries + 1):
+            try:
+                with _obs.span(f"anchor:{what}", attempt=attempt):
+                    mpiT.Wait(req, timeout=t)
+                break
+            except mpiT.CompatTimeoutError:
+                # The request stays posted — retry the WAIT (never the
+                # send: a duplicate TAG_EXCH would double-update the
+                # center) with a grown window.
+                _obs.counter("anchor_retries", 1, rank=self._rank)
+                if attempt >= self._cfg.exchange_retries:
+                    raise AnchorTimeoutError(
+                        f"anchor {what} on rank {self._rank}: no reply "
+                        f"after {attempt + 1} waits (last {t:.3g}s)"
+                    ) from None
+                t *= self._cfg.backoff
+        self.version = int(self._buf[0])
+        self.alpha = float(self._buf[1])
+        return self._buf[2:]
+
+    # -- lifecycle -----------------------------------------------------------
+    def register(self, step: int = 0) -> np.ndarray:
+        self._step = step
+        return self._rpc(
+            TAG_REG, np.asarray([step], np.int32), "register"
+        ).copy()
+
+    def rejoin(self, step: int) -> np.ndarray:
+        """Re-register after a crash-restore (or quarantine-restore) and
+        pull the CURRENT anchor; returns the center (apply the elastic
+        pull to the restored params before training on)."""
+        self._step = step
+        return self._rpc(
+            TAG_REJOIN, np.asarray([step], np.int32), "rejoin"
+        ).copy()
+
+    def exchange(self, flat: np.ndarray, step: int) -> np.ndarray:
+        """One EASGD round trip: push ``x_i`` (stamped with the last
+        anchor version this replica saw — the server's staleness
+        input), pull the center, return the elastically-pulled params."""
+        self._step = step
+        payload = np.concatenate(
+            [np.asarray([self.version], np.float32),
+             np.asarray(flat, np.float32)]
+        )
+        center = self._rpc(TAG_EXCH, payload, "exchange")
+        return flat - np.float32(self.alpha) * (flat - center)
+
+    def quarantine(self, step: int) -> None:
+        """Tell the anchor this replica's params are poisoned: it stops
+        counting toward the denominator and nothing is pushed."""
+        mpiT.Isend(
+            np.asarray([step], np.int32), dest=SERVER_RANK, tag=TAG_QUAR,
+            comm=self._ship,
+        )
+
+    def stop(self, step: int) -> None:
+        self.stop_heartbeats()
+        mpiT.Isend(
+            np.asarray([step], np.int32), dest=SERVER_RANK, tag=TAG_STOP,
+            comm=self._ship,
+        )
+
+    # -- heartbeats ----------------------------------------------------------
+    def start_heartbeats(self) -> None:
+        if self._hb_stop is not None:
+            return
+        stop = self._hb_stop = threading.Event()
+        rank, ship, cfg = self._rank, self._ship, self._cfg
+        # The replica thread's (possibly per-rank) recorder: heartbeat
+        # sends must be charged to THIS rank's event stream, or the
+        # flight recorder's gathered send matrix disagrees with the
+        # server's receive counts by exactly the heartbeat traffic.
+        rank_rec = _obs.get_recorder()
+
+        def _beat():
+            # The helper thread adopts the replica's rank identity so
+            # its sends carry the right source (compat.bind_thread) AND
+            # the replica's recorder so they are attributed to it.
+            mpiT.bind_thread(rank, ship)
+            rec_ctx = (
+                _obs.local_recorder(rank_rec) if rank_rec is not None
+                else contextlib.nullcontext()
+            )
+            with rec_ctx:
+                while not stop.wait(cfg.heartbeat_s):
+                    if time.monotonic() < self._hb_suspend_until:
+                        continue  # a simulated full-process stall
+                    mpiT.Send(
+                        np.asarray([self._step], np.int32),
+                        dest=SERVER_RANK, tag=TAG_HB, comm=ship,
+                    )
+
+        t = threading.Thread(
+            target=_beat, daemon=True, name=f"elastic-hb-{rank}"
+        )
+        t.start()
+
+    def suspend_heartbeats(self, seconds: float) -> None:
+        """Model a full-process stall (``FaultPlan.hang_at``): compute
+        AND heartbeats stop, so the lease can expire."""
+        self._hb_suspend_until = time.monotonic() + seconds
+
+    def stop_heartbeats(self) -> None:
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            self._hb_stop = None
+
+
+# ---------------------------------------------------------------------------
+# Replica runner: hardened_loop + anchor exchange + fault application.
+# ---------------------------------------------------------------------------
+
+
+class _RestoreHookLogger:
+    """MetricLogger wrapper watching for ``hardened_loop``'s
+    ``restored_after_divergence`` event — the seam through which a
+    divergence restore triggers the anchor rejoin (the loop owns the
+    restore; the elastic tier only needs to know it happened).
+
+    Also accumulates every logged loss into ``losses``: a crashed
+    ``hardened_loop`` invocation never returns its result, so the
+    replica's loss trajectory must be collected at the logging seam or
+    the pre-crash segment silently vanishes (a replica killed before
+    its first checkpoint would report ``final_loss=nan`` despite
+    training). Log-point cadence, like the loop's own trace; entries
+    logged on a later-abandoned (pre-rollback) segment stay in the
+    list — it is a diagnostic trajectory, not a resume input."""
+
+    def __init__(self, inner: MetricLogger, hook: Callable[[int], None]):
+        self._inner = inner
+        self._hook = hook
+        self.losses: list[float] = []
+
+    def log(self, step: int, metrics: dict) -> None:
+        if metrics.get("event") == "restored_after_divergence":
+            self._hook(int(step))
+        loss = metrics.get("loss")
+        if isinstance(loss, (int, float)):
+            self.losses.append(float(loss))
+        self._inner.log(step, metrics)
+
+
+def _replica_body(
+    rank: int,
+    ridx: int,
+    world,
+    cfg: ElasticConfig,
+    init_state: Callable[[], Any],
+    step_fn: Callable,
+    stream_factory: Callable[[int, int], Iterator],
+    plan: FaultPlan | None,
+    items_per_batch: int | None,
+    verbose: bool,
+) -> dict:
+    import jax.numpy as jnp
+
+    state0 = init_state()
+    flat_dim = int(np.asarray(state0.params).size)
+    client = AnchorClient(flat_dim, cfg)
+    client.register(0)
+    client.start_heartbeats()
+    ckpt = (
+        AtomicCheckpoint(
+            os.path.join(cfg.ckpt_dir, f"replica{ridx}"),
+            max_to_keep=cfg.max_to_keep,
+        )
+        if cfg.ckpt_dir
+        else None
+    )
+    stats = {
+        "replica": ridx, "restores": 0, "rejoins": 0, "quarantines": 0,
+        "crashes": 0, "exchanges": 0,
+    }
+    # Host-side step cursor + cross-call flags shared between the
+    # wrapped step, the restore hook, and the crash supervisor. The
+    # cursor (not ``int(state.step)``) keys fault application and sync
+    # cadence so the async pipeline never pays a per-step device fetch.
+    cell: dict[str, Any] = {"k": 0, "quarantined": False, "pending_center": None}
+
+    def _on_restore(restored_step: int) -> None:
+        # hardened_loop just restored this replica from its checkpoint
+        # (DivergenceGuard). Re-sync the cursor, then rejoin the anchor:
+        # pull the current center and stage the elastic pull for the
+        # next wrapped call (the hook cannot mutate the loop's state).
+        cell["k"] = restored_step
+        stats["restores"] += 1
+        center = client.rejoin(restored_step)
+        cell["pending_center"] = (center, client.alpha)
+        cell["quarantined"] = False
+        stats["rejoins"] += 1
+
+    def wrapped(state, batch):
+        k = cell["k"]
+        pc = cell.pop("pending_center", None)
+        if pc is not None:
+            center, alpha = pc
+            flat = np.asarray(state.params, np.float32)
+            state = state._replace(
+                params=jnp.asarray(flat - np.float32(alpha) * (flat - center))
+            )
+        if plan is not None:
+            act = plan.step_action(rank, k)  # may raise ReplicaKilled
+            if act.hang_s:
+                # Full-process stall: heartbeats stop too — the lease
+                # expires, the anchor evicts, and the resumed heartbeat
+                # re-admits (the hang→evict→readmit path).
+                client.suspend_heartbeats(act.hang_s)
+                time.sleep(act.hang_s)
+            elif act.sleep_s:
+                time.sleep(act.sleep_s)
+        state, metrics = step_fn(state, batch)
+        if plan is not None and act.nan:
+            # Poison the step's params: the NEXT loss is non-finite, the
+            # guard raises at its fence, and the quarantine check below
+            # keeps the poison out of the anchor meanwhile.
+            state = state._replace(
+                params=state.params * jnp.float32(float("nan"))
+            )
+        k += 1
+        cell["k"] = k
+        client._step = k
+        if k % cfg.sync_every == 0:
+            flat = np.asarray(state.params, np.float32)
+            if not np.all(np.isfinite(flat)):
+                # DivergenceGuard quarantine: a diverged replica must
+                # never push — one poisoned x_i would NaN the center
+                # for the whole fleet.
+                if not cell["quarantined"]:
+                    cell["quarantined"] = True
+                    stats["quarantines"] += 1
+                    client.quarantine(k)
+                    _obs.instant("replica_diverged_local", rank=rank, step=k)
+            elif not cell["quarantined"]:
+                with _obs.span("elastic_exchange", step=k):
+                    pulled = client.exchange(flat, k)
+                stats["exchanges"] += 1
+                state = state._replace(params=jnp.asarray(pulled))
+        return state, metrics
+
+    logger = _RestoreHookLogger(MetricLogger(stdout=verbose), _on_restore)
+    transform = lambda b: {k: jnp.asarray(v) for k, v in b.items()}  # noqa: E731
+    result = None
+    state = state0
+    start_step = 0
+    if ckpt is not None and ckpt.latest_step() is not None:
+        # A relaunched fleet (the whole process crashed and was
+        # restarted — the chaos e2e path) resumes each replica from its
+        # latest crash-consistent checkpoint; the anchor center is soft
+        # state, rebuilt from the replicas' first exchanges.
+        state = ckpt.restore(state0)
+        start_step = int(state.step)
+        cell["k"] = start_step
+        stats["resumed_from"] = start_step
+    t0 = time.perf_counter()
+    try:
+        while True:
+            try:
+                result = hardened_loop(
+                    world,
+                    state,
+                    wrapped,
+                    stream_factory(ridx, start_step),
+                    steps=cfg.steps,
+                    transform=transform,
+                    items_per_batch=items_per_batch,
+                    log_every=cfg.log_every,
+                    logger=logger,
+                    ckpt=ckpt,
+                    ckpt_every=cfg.ckpt_every if ckpt else 0,
+                    specs=(lambda: None) if ckpt else None,
+                    max_restores=cfg.max_restores,
+                    fetch_lag=cfg.fetch_lag,
+                )
+                break
+            except ReplicaKilled as rk:
+                # Crash: the thread's heart stops; the anchor evicts on
+                # lease expiry. Rejoin = restore the latest
+                # crash-consistent checkpoint, re-register, pull the
+                # anchor, resume the loop for the remaining steps.
+                stats["crashes"] += 1
+                client.stop_heartbeats()
+                _obs.instant("replica_crashed", rank=rank, step=rk.step)
+                if not cfg.rejoin or ckpt is None or ckpt.latest_step() is None:
+                    stats["dead_at"] = rk.step
+                    break
+                if plan is not None and plan.rejoin_delay_s > 0:
+                    time.sleep(plan.rejoin_delay_s)
+                state = ckpt.restore(state0)
+                start_step = int(state.step)
+                stats["rejoin_steps_to_recover"] = rk.step - start_step
+                center = client.rejoin(start_step)
+                cell["pending_center"] = (center, client.alpha)
+                cell["k"] = start_step
+                cell["quarantined"] = False
+                stats["rejoins"] += 1
+                client.start_heartbeats()
+    finally:
+        client.stop(cell["k"])
+    wall = time.perf_counter() - t0
+    steps_done = int(result["steps"]) if result else cell["k"]
+    # The trajectory comes from the logging seam, not the loop result:
+    # a crashed segment's losses would otherwise vanish with the
+    # never-returned result (see _RestoreHookLogger).
+    losses = logger.losses
+    out = {
+        **stats,
+        "steps": steps_done,
+        "losses": losses,
+        "final_loss": losses[-1] if losses else float("nan"),
+        "wall_s": round(wall, 3),
+        "steps_per_s": round(steps_done / wall, 3) if wall > 0 else 0.0,
+        "completed": result is not None,
+    }
+    if result:
+        out["loop_restores"] = result["restores"]
+        for key in ("items_per_sec", "items_per_sec_last", "items_per_sec_mean"):
+            if key in result:
+                out[key] = result[key]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fleet launcher.
+# ---------------------------------------------------------------------------
+
+
+def run_elastic(
+    world,
+    cfg: ElasticConfig,
+    init_state: Callable[[], Any],
+    step_fn: Callable,
+    stream_factory: Callable[[int, int], Iterator],
+    *,
+    fault_plan: FaultPlan | None = None,
+    sentinel=None,
+    items_per_batch: int | None = None,
+    job_timeout_s: float = 600.0,
+    flight: bool = True,
+    verbose: bool = False,
+) -> dict:
+    """Launch the elastic fleet: 1 anchor server + ``cfg.replicas``
+    replicas on the compat layer (the ``mpirun -n P`` shape).
+
+    Args:
+      world: the jax World (prefetch plumbing only — replicas place
+        whole batches; no SPMD sharding inside a replica).
+      init_state: ``() -> TrainState`` with ``params`` = the flat f32
+        vector (fresh per replica; all replicas start from the same
+        init, which also seeds the anchor center).
+      step_fn: the SHARED jitted local step ``(state, batch) -> (state,
+        metrics)`` — one compile serves every replica.
+      stream_factory: ``(replica_idx, skip) -> batch iterator`` (skip =
+        steps already trained, for the rejoin resume).
+      fault_plan: seeded :class:`~mpit_tpu.compat.faults.FaultPlan` —
+        message faults install on the job's wire; step faults apply in
+        the replica wrapper.
+      flight: record per-rank telemetry (``obs.local_recorder`` per
+        rank) and gather it to the server at end of job — the result's
+        ``flight`` block carries the per-phase skew report naming any
+        straggler (PR 3's flight recorder, exercised on real threads).
+      sentinel: optional :class:`mpit_tpu.obs.Sentinel` — the server
+        notes evictions/staleness breaches into it.
+
+    Returns ``{"server": {...}, "replicas": [...], "center", "version",
+    "flight": {...}, "fault_events": (...)}``.
+    """
+    from mpit_tpu.obs import aggregate
+
+    nranks = cfg.replicas + 1
+    state0 = init_state()
+    init_flat = np.asarray(state0.params, np.float32).copy()
+    del state0
+
+    def main(rank: int):
+        rec_ctx = (
+            _obs.local_recorder(_obs.Recorder()) if flight
+            else contextlib.nullcontext()
+        )
+        with rec_ctx:
+            if rank == SERVER_RANK:
+                out = anchor_server(init_flat, cfg, sentinel=sentinel)
+            else:
+                out = _replica_body(
+                    rank, rank - 1, world, cfg, init_state, step_fn,
+                    stream_factory, fault_plan, items_per_batch, verbose,
+                )
+            per_rank = aggregate.gather_compat(root=SERVER_RANK) if flight else None
+        if rank == SERVER_RANK and per_rank is not None:
+            out["_flight"] = {
+                "skew": aggregate.skew_report(per_rank),
+                "record": aggregate.flight_record(per_rank),
+            }
+        return out
+
+    results = mpiT.run(
+        main, nranks, pass_rank=True, timeout=job_timeout_s,
+        fault_plan=fault_plan,
+    )
+    server = results[SERVER_RANK]
+    flight_doc = server.pop("_flight", None)
+    out = {
+        "server": server,
+        "replicas": results[1:],
+        "center": server["center"],
+        "version": server["version"],
+    }
+    if flight_doc is not None:
+        # The headline question ("which replica straggled?") reads the
+        # TRAINING step phase — the record's global max-skew phase is
+        # usually the server's probe_wait (it idles by design).
+        step_skew = flight_doc["skew"].get("step")
+        if step_skew is not None:
+            flight_doc["step_straggler_rank"] = step_skew["max_rank"]
+        out["flight"] = flight_doc
+    if fault_plan is not None:
+        out["fault_events"] = fault_plan.events()
+    if sentinel is not None:
+        out["sentinel"] = sentinel.report()
+    return out

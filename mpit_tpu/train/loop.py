@@ -615,6 +615,13 @@ def hardened_loop(
         # multi-x slowdowns) — the e2e img/s the rehearsal script reads.
         out["items_per_sec"] = round(max(rate_trace), 2)
         out["items_per_sec_last"] = round(rate_trace[-1], 2)
+        # Mean over ALL logged windows: the stable figure for runs whose
+        # per-window rate is scheduling-noisy (the elastic tier's
+        # replica threads share host cores — ISSUE 11's healthy-vs-
+        # straggler throughput comparison reads this, not the max).
+        out["items_per_sec_mean"] = round(
+            sum(rate_trace) / len(rate_trace), 2
+        )
     if compile_watch.compiles:
         # Lifetime compiles this loop observed (expected: 1, the first
         # step); unexpected ones were already flagged live.

@@ -279,6 +279,34 @@ def _realistic_results():
                 "instants": {"slo_breach": 12, "slo_recovered": 9},
             },
         },
+        # ISSUE 11: the elastic tier's robustness triple rides the
+        # line; fleet geometry and the per-scenario evidence blocks
+        # (straggler skew, kill/rejoin lifecycle) are detail-only.
+        "mnist_easgd": {
+            "easgd_acc_delta_vs_sync": -0.0123,
+            "straggler_healthy_throughput_pct": 123.4,
+            "rejoin_steps_to_recover": 12,
+            "replicas": 2,
+            "steps_per_replica": 60,
+            "sync_accuracy": 0.9961,
+            "elastic_accuracy": 0.9838,
+            "anchor_version": 30,
+            "straggler": {
+                "rank": 2, "slowdown_s_per_step": 0.03,
+                "healthy_items_per_sec": 5086.7,
+                "nofault_items_per_sec": 3060.2,
+                "straggler_named_by_skew": True,
+                "step_skew_s": 2.435826, "staleness_events": 3,
+                "accuracy": 0.9838,
+            },
+            "kill_rejoin": {
+                "kill_step": 35, "evictions": 1, "rejoins": 1,
+                "crashes": 1, "completed": True, "accuracy": 0.9838,
+                "acc_delta_vs_nofault": -0.0123,
+            },
+            "phases": phases,
+            "obs_baseline": obs_baseline,
+        },
         "allreduce": {
             "gbps": 50.88,
             # ISSUE 9: the ring + quantized-ring figures join the line
@@ -366,6 +394,17 @@ class TestLineBudget:
         assert "devices" not in ar
         assert "global_batch" not in rec["detail"]["resnet50"]
         assert "seq_len" not in rec["detail"]["gpt2"]
+        # ISSUE 11 budget payment: more static geometry echo off the
+        # line (all in BENCH_DETAIL.json verbatim), plus gpt2's
+        # app_path number — exactly derivable from tokens_per_sec and
+        # app_path_overhead_pct, both still on the line — and
+        # gpt2_moe's final_loss (detail carries the full trajectory).
+        assert "global_batch" not in rec["detail"]["alexnet"]
+        assert "batch" not in rec["detail"]["gpt2"]
+        assert "app_path_tokens_per_sec" not in rec["detail"]["gpt2"]
+        assert "batch" not in rec["detail"]["gpt2_moe"]
+        assert "seq_len" not in rec["detail"]["gpt2_moe"]
+        assert "final_loss" not in rec["detail"]["gpt2_moe"]
         assert rec["detail"]["devices"] == 8
         # The serving workload (ISSUE 4): decode tokens/s + request
         # latency p50/p95 ride the line — joined by the resolved
@@ -418,6 +457,16 @@ class TestLineBudget:
             assert off_line not in slo
         assert "dispatch" not in rec["detail"]["gpt2_moe"]
         assert "requests" not in rec["detail"]["gpt2_serve"]
+        # ISSUE 11: the elastic tier's robustness triple rides the
+        # line; fleet geometry and the evidence blocks stay detail-only.
+        easgd = rec["detail"]["mnist_easgd"]
+        assert easgd["easgd_acc_delta_vs_sync"] == -0.0123
+        assert easgd["straggler_healthy_throughput_pct"] == 123.4
+        assert easgd["rejoin_steps_to_recover"] == 12
+        for off_line in ("straggler", "kill_rejoin", "replicas",
+                         "steps_per_replica", "sync_accuracy",
+                         "elastic_accuracy", "anchor_version"):
+            assert off_line not in easgd
         # ISSUE 8: every train workload's mfu_pct rides the line; the
         # full measured-vs-modeled roofline block is detail-only.
         assert rec["detail"]["alexnet"]["mfu_pct"] == 52.34
@@ -467,7 +516,7 @@ class TestLineBudget:
         # Worst case: every workload died before producing numbers.
         rec = json.loads(_line({}, truncated=[
             "allreduce", "alexnet", "gpt2", "resnet50", "gpt2_moe",
-            "gpt2_serve", "gpt2_slo",
+            "gpt2_serve", "gpt2_slo", "mnist_easgd",
         ], elapsed_s=0.5))
         assert rec["value"] is None
         assert rec["vs_baseline"] is None

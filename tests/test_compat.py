@@ -287,6 +287,128 @@ def test_collective_buffer_reuse_after_return():
     assert all(mpiT.run(main, 4))
 
 
+class TestTimeouts:
+    """ISSUE 11 satellite: ``Recv``/``Wait``/``Probe`` grow ``timeout=``
+    raising a structured :class:`CompatTimeoutError` (peer rank + tag)
+    instead of blocking forever on a dead peer."""
+
+    def test_recv_timeout_carries_envelope(self):
+        def main():
+            mpiT.Init()
+            r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+            if r == 1:
+                return None  # never sends
+            try:
+                mpiT.Recv(np.zeros(2), src=1, tag=7, timeout=0.05)
+            except mpiT.CompatTimeoutError as e:
+                return (e.op, e.rank, e.src, e.tag)
+
+        out = mpiT.run(main, 2, timeout=30)
+        assert out[0] == ("Recv", 0, 1, 7)
+
+    def test_recv_timeout_withdraws_posted_receive(self):
+        """After a timed-out Recv, a late message must NOT land in the
+        abandoned buffer — it queues as unexpected and a fresh Recv
+        gets it."""
+        import threading
+
+        sent = threading.Event()
+
+        def main():
+            mpiT.Init()
+            r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+            if r == 1:
+                sent.wait(10)
+                mpiT.Send(np.asarray([5.0]), dest=0, tag=3)
+                return None
+            stale = np.zeros(1)
+            with pytest.raises(mpiT.CompatTimeoutError):
+                mpiT.Recv(stale, src=1, tag=3, timeout=0.05)
+            sent.set()
+            fresh = np.zeros(1)
+            mpiT.Recv(fresh, src=1, tag=3, timeout=5.0)
+            return (float(stale[0]), float(fresh[0]))
+
+        out = mpiT.run(main, 2, timeout=30)
+        assert out[0] == (0.0, 5.0)
+
+    def test_wait_timeout_then_retry_succeeds(self):
+        import threading
+
+        release = threading.Event()
+
+        def main():
+            mpiT.Init()
+            r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+            if r == 1:
+                release.wait(10)
+                mpiT.Send(np.ones(2), dest=0, tag=1)
+                return None
+            buf = np.zeros(2)
+            req = mpiT.Irecv(buf, src=1, tag=1)
+            with pytest.raises(mpiT.CompatTimeoutError):
+                mpiT.Wait(req, timeout=0.05)
+            release.set()
+            # The request stayed posted: the retry completes it — the
+            # anchor client's retry/backoff is built on exactly this.
+            st = mpiT.Wait(req, timeout=5.0)
+            assert st.source == 1
+            return buf.copy()
+
+        out = mpiT.run(main, 2, timeout=30)
+        np.testing.assert_array_equal(out[0], np.ones(2))
+
+    def test_probe_timeout(self):
+        def main():
+            mpiT.Init()
+            if mpiT.Comm_rank(mpiT.COMM_WORLD) == 1:
+                return None
+            with pytest.raises(mpiT.CompatTimeoutError) as ei:
+                mpiT.Probe(mpiT.ANY_SOURCE, mpiT.ANY_TAG, timeout=0.05)
+            return (ei.value.op, "any" in str(ei.value))
+
+        out = mpiT.run(main, 2, timeout=30)
+        assert out[0] == ("Probe", True)
+
+    def test_no_timeout_still_blocks_until_delivery(self):
+        def main():
+            mpiT.Init()
+            r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+            if r == 1:
+                import time
+
+                time.sleep(0.1)
+                mpiT.Send(np.asarray([9.0]), dest=0, tag=2)
+                return None
+            buf = np.zeros(1)
+            mpiT.Recv(buf, src=1, tag=2, timeout=10.0)
+            return float(buf[0])
+
+        out = mpiT.run(main, 2, timeout=30)
+        assert out[0] == 9.0
+
+
+def test_job_timeout_dumps_mailbox_state(capfd):
+    """Deadlock watchdog (ISSUE 11 satellite): a timed-out job dumps
+    every rank's mailbox state (pending/posted envelopes) to stderr
+    before aborting, so a hang names the stuck cycle."""
+
+    def main():
+        mpiT.Init()
+        r = mpiT.Comm_rank(mpiT.COMM_WORLD)
+        if r == 0:
+            mpiT.Send(np.ones(1), dest=1, tag=42)  # unexpected at rank 1
+            return None
+        mpiT.Recv(np.zeros(1), src=0, tag=99)  # never satisfied: deadlock
+
+    with pytest.raises(TimeoutError):
+        mpiT.run(main, 2, timeout=1.0)
+    err = capfd.readouterr().err
+    assert "per-rank mailbox state" in err
+    assert '"tag": 42' in err  # the pending unexpected message
+    assert '"tag": 99' in err  # the posted never-matched receive
+
+
 def test_allreduce_matches_tpu_collective(world8):
     """Parity: the simulator's Allreduce equals the real device-collective
     allreduce (comm.collectives via shard_map) on the same per-rank data."""

@@ -17,6 +17,7 @@ equality between the cached and uncached paths, not dtype tolerance.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -1349,13 +1350,21 @@ class TestRunTimed:
         _, params = model_and_params
         engine = _warmed_engine(params)
         # Offered load far beyond a 2-slot engine: the queue cannot
-        # drain inside the window.
+        # drain inside the window. Service is throttled via on_tick so
+        # "cannot keep up" holds on ANY host speed: ≤150 ticks fit in
+        # the window, each request needs ~3 (prefill + 2 decode), so at
+        # most ~100 of the ~180 offered requests can complete — on a
+        # fast container the unthrottled engine kept pace with 300
+        # req/s and the overload premise silently evaporated (flake).
         arr = generate_arrivals(
             LoadSpec(rate=300.0, classes=TEST_MIX),
             vocab_size=CFG.vocab_size, duration_s=0.6, seed=0,
         )
         server = Server(engine)
-        done = server.run_timed(arr, duration=0.6, drain=False)
+        done = server.run_timed(
+            arr, duration=0.6, drain=False,
+            on_tick=lambda s, now: time.sleep(0.004),
+        )
         assert len(done) < len(arr)
         assert server.stats()["truncated"] is True
 
