@@ -34,6 +34,15 @@ per-slot KV cache and the request loop is continuous batching.
   arrival clock; paired with ``obs.stream`` rolling-window telemetry
   and ``obs.slo`` SLO monitoring, this is the "heavy traffic" harness
   the ``gpt2_slo`` bench sweep measures.
+- :mod:`~mpit_tpu.serve.policy` — the scheduling-policy tier (ISSUE
+  12): priority classes drained in tier order, deficit-weighted
+  round-robin tenant fairness within a tier (bounded deficit counters),
+  projected-TTFT admission shedding (``shed_admission`` vs
+  ``shed_queue_full`` kept apart), and paged-KV preemption — park a
+  low-tier generation (pages freed, tokens kept host-side), resume it
+  through chunked prefill with a pinned greedy bit-match. Plug in via
+  ``Server(policy=SchedulingPolicy(...))``; without one the scheduler
+  is the FIFO loop unchanged.
 - :mod:`~mpit_tpu.serve.weights` — dense-checkpoint ingestion: a
   ``train.convert --save-dense`` ``.npz`` from ANY training tier serves
   directly (leaf contract pinned in ``tests/test_convert.py``).
@@ -60,6 +69,12 @@ from mpit_tpu.serve.loadgen import (
     generate_arrivals,
     parse_load_spec,
 )
+from mpit_tpu.serve.policy import (
+    PolicyConfig,
+    SchedulingPolicy,
+    TTFTProjector,
+    parse_policy_spec,
+)
 from mpit_tpu.serve.scheduler import Completed, Request, Server, warm_engine
 from mpit_tpu.serve.weights import (
     expected_param_shapes,
@@ -75,9 +90,13 @@ __all__ = [
     "LoadSpec",
     "PageAllocator",
     "PagedKVCache",
+    "PolicyConfig",
     "Request",
     "RequestClass",
+    "SchedulingPolicy",
     "Server",
+    "TTFTProjector",
+    "parse_policy_spec",
     "alloc_cache",
     "alloc_paged_cache",
     "cache_specs",

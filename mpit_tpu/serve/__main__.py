@@ -43,6 +43,17 @@ windows each tick, breaches land in the trace / the sentinel, and the
 final JSON carries the monitor's report (time in breach, time to
 detect). ``--max-queue`` bounds intake (excess arrivals shed).
 
+``--policy`` (ISSUE 12) swaps the FIFO scheduler for the scheduling-
+policy tier (``serve.policy``): ``--policy on`` takes the defaults, or
+a spec like ``"quantum=4,preempt=1,admission_factor=1.2,weight.t0=2"``.
+Priority classes and per-class TTFT targets ride the load spec
+(``--loadgen "...,priority=1,ttft_target=0.2"`` stamps every class; the
+programmatic mixture sets them per class). The live stats line grows
+``pre=`` (preemptions) under a policy, and the final JSON carries the
+policy block (preemptions, resumes, admission sheds, tier depths) plus
+the per-tenant roll-up and cause-split shed counts from
+``Server.stats()``.
+
 Config follows the ``asyncsgd.config`` pattern: one dataclass, argparse
 generated from its fields.
 """
@@ -111,6 +122,10 @@ class ServeConfig:
     slo_ttft_p95: float = 0.0
     slo_latency_p95: float = 0.0
     slo_shed_rate: float = 0.0
+    # Scheduling policy (ISSUE 12). "" = FIFO; "on" = defaults; or a
+    # serve.policy spec: "quantum=4,preempt=1,admission_factor=1.2,
+    # weight.<tenant>=2". Pair with --loadgen priority=/ttft_target=.
+    policy: str = ""
 
     def mesh_shape(self) -> dict[str, int] | None:
         from mpit_tpu.asyncsgd.config import parse_mesh
@@ -215,6 +230,8 @@ def _live_line(registry, monitor, server, now: float) -> str:
         f"q={g.get('queue_depth', 0.0):.0f} "
         f"done={len(server.completed)} shed={len(server.shed)}"
     )
+    if server.policy is not None:
+        line += f" pre={server.policy.preemptions}"
     if "kv_pool_occupancy" in g:
         # Cache-MEMORY efficiency next to slot occupancy (ISSUE 7):
         # pool fill, tokens actually held, pages stored once but
@@ -257,9 +274,11 @@ def main(argv: list[str] | None = None) -> dict:
     from mpit_tpu.obs.slo import SLOMonitor
     from mpit_tpu.obs.stream import StreamRegistry
     from mpit_tpu.serve import (
+        SchedulingPolicy,
         Server,
         generate_arrivals,
         parse_load_spec,
+        parse_policy_spec,
         warm_engine,
     )
 
@@ -274,6 +293,11 @@ def main(argv: list[str] | None = None) -> dict:
     targets = _slo_targets(cfg)
     monitor = (
         SLOMonitor(targets, registry, sentinel=sentinel) if targets else None
+    )
+    policy = (
+        SchedulingPolicy(parse_policy_spec(cfg.policy), registry)
+        if cfg.policy
+        else None
     )
     spec = parse_load_spec(cfg.loadgen) if cfg.loadgen else None
     if spec is not None:
@@ -313,6 +337,7 @@ def main(argv: list[str] | None = None) -> dict:
             stream=registry,
             slo=monitor,
             max_queue=cfg.max_queue or None,
+            policy=policy,
         )
         last_line = [0.0]
 
@@ -343,6 +368,7 @@ def main(argv: list[str] | None = None) -> dict:
             stream=registry,
             slo=monitor,
             max_queue=cfg.max_queue or None,
+            policy=policy,
         )
         for req in synthetic_requests(cfg, mcfg.vocab_size):
             server.submit(req)

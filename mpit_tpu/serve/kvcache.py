@@ -468,6 +468,17 @@ class PageAllocator:
             self.reserved -= excess
 
     # -- release ------------------------------------------------------------
+    def slot_page_stats(self, slot: int) -> tuple:
+        """``(owned, shared)`` pages currently mapped by ``slot``:
+        ``owned`` = sole-owner pages :meth:`free_slot` would return to
+        the free list, ``shared`` = pages that would merely drop a
+        refcount. The preemption path's pool-accounting observable
+        (ISSUE 12): evicting a victim must free exactly its non-shared
+        pages — test-pinned."""
+        pages = self._slot_pages.get(slot, [])
+        owned = sum(1 for p in pages if self.refcount[p] == 1)
+        return owned, len(pages) - owned
+
     def free_slot(self, slot: int) -> None:
         """Unmap ``slot``'s pages; pages at refcount 0 return to the
         free list and any prefix-index entries citing them die (their

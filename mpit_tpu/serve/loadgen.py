@@ -62,6 +62,13 @@ class RequestClass:
     ALL classes: a class with a shorter ``prefix_len`` uses the first
     tokens of the longest one, so class prefixes nest. Total prompt
     length becomes ``prefix_len + draw(prompt_len)``.
+
+    ``priority`` / ``ttft_target_s`` (ISSUE 12 satellite) are stamped
+    verbatim onto every generated ``Request`` of the class — the
+    scheduling-policy tier (0 = highest) and the per-class TTFT target
+    its admission/preemption decisions are made against (0 = none).
+    Neither consumes rng, so prior specs keep their pinned arrival
+    streams byte-identical.
     """
 
     name: str
@@ -69,6 +76,8 @@ class RequestClass:
     prompt_len: tuple[int, int] = (4, 16)
     max_new_tokens: tuple[int, int] = (8, 32)
     prefix_len: int = 0
+    priority: int = 0
+    ttft_target_s: float = 0.0
 
     def __post_init__(self):
         for field, (lo, hi) in (
@@ -88,6 +97,16 @@ class RequestClass:
             raise ValueError(
                 f"class {self.name!r}: prefix_len must be >= 0, got "
                 f"{self.prefix_len}"
+            )
+        if self.priority < 0:
+            raise ValueError(
+                f"class {self.name!r}: priority must be >= 0, got "
+                f"{self.priority}"
+            )
+        if self.ttft_target_s < 0:
+            raise ValueError(
+                f"class {self.name!r}: ttft_target_s must be >= 0, got "
+                f"{self.ttft_target_s}"
             )
 
     @property
@@ -251,6 +270,8 @@ def generate_arrivals(
                     top_k=spec.top_k,
                     eos_id=eos_id,
                     tenant=tenant,
+                    priority=klass.priority,
+                    ttft_target_s=klass.ttft_target_s,
                 ),
             )
         )
@@ -280,11 +301,16 @@ def parse_load_spec(text: str) -> LoadSpec:
     Optional ``prompt_min/prompt_max/new_min/new_max`` replace the
     default interactive/batch mixture with a single uniform class over
     those ranges; ``prefix=N`` gives every class an N-token shared
-    prefix (the trace-wide system prompt).
+    prefix (the trace-wide system prompt); ``priority=P`` /
+    ``ttft_target=S`` (ISSUE 12 satellite) stamp the scheduling-policy
+    tier and per-class TTFT target onto every class — none of the three
+    consumes rng, so prefix-free/priority-free specs keep their pinned
+    arrival streams byte-identical.
     """
     kw: dict = {}
     ranges: dict[str, int] = {}
     prefix = 0
+    stamp: dict = {}
     for part in text.split(","):
         part = part.strip()
         if not part:
@@ -301,10 +327,14 @@ def parse_load_spec(text: str) -> LoadSpec:
             ranges[key] = int(val)
         elif key == "prefix":
             prefix = int(val)
+        elif key == "priority":
+            stamp["priority"] = int(val)
+        elif key == "ttft_target":
+            stamp["ttft_target_s"] = float(val)
         else:
             raise ValueError(
                 f"unknown --loadgen key {key!r} (valid: "
-                f"{', '.join((*_SPEC_KEYS, *_RANGE_KEYS, 'prefix'))})"
+                f"{', '.join((*_SPEC_KEYS, *_RANGE_KEYS, 'prefix', 'priority', 'ttft_target'))})"
             )
     if "rate" not in kw:
         raise ValueError("--loadgen needs rate=<req/s>")
@@ -319,8 +349,10 @@ def parse_load_spec(text: str) -> LoadSpec:
             ),
         )
     if prefix:
+        stamp["prefix_len"] = prefix
+    if stamp:
         kw["classes"] = tuple(
-            dataclasses.replace(c, prefix_len=prefix)
+            dataclasses.replace(c, **stamp)
             for c in kw.get("classes", DEFAULT_MIX)
         )
     return LoadSpec(**kw)

@@ -74,6 +74,26 @@ decode write and runs the device page copy it returns); retirement
 frees the slot's pages back to the pool. ``kv_tokens_cached`` /
 ``kv_pool_occupancy`` / ``prefix_pages_shared`` gauges land in the
 Recorder and the stream windows each tick.
+
+ISSUE 12 (scheduling policy): ``Server(policy=SchedulingPolicy(...))``
+replaces the FIFO deque with the policy tier (``serve.policy``) —
+priority-ordered tenant-fair queues consulted at every admit boundary,
+projected-TTFT admission shedding at submit (``shed_admission``,
+distinct from ``max_queue``'s ``shed_queue_full`` in every counter /
+instant / stats key), and preemption on the paged engine: when the
+best queued tier's head is projected to miss its TTFT target and
+nothing frees, a lower-tier live generation is PARKED — pages freed
+back to the allocator, generated-so-far tokens kept host-side — and
+later resumed through the normal chunked-prefill path with
+``feed = prompt + tokens`` (the resume prefill recomputes exactly the
+decode tick the eviction displaced, so a preempted-then-resumed greedy
+request bit-matches its un-preempted output — test-pinned). The
+policy's projector reads ``prefill_tick`` / ``decode_tick`` rolling
+windows this server feeds once per tick; per-tier TTFT series
+(``request_ttft_tier<p>``) and per-tenant series
+(``request_ttft_tenant:<t>``) land in the registry so SLOs and the
+``stats()`` tenant roll-up can tell the classes apart. Without a
+policy every path below is byte-for-byte the FIFO scheduler.
 """
 
 from __future__ import annotations
@@ -128,7 +148,11 @@ class Request:
     """One generation request. ``temperature <= 0`` = greedy;
     ``top_k = 0`` = full vocab; ``eos_id = None`` = never stop early;
     ``tenant`` labels the requester (multi-tenant load traces) and is
-    stamped on the request's spans when non-empty."""
+    stamped on the request's spans when non-empty. ``priority`` is the
+    scheduling-policy tier (0 = highest / interactive; ignored by the
+    FIFO scheduler) and ``ttft_target_s`` the per-request TTFT SLO the
+    policy's admission/preemption decisions are made against (<= 0 =
+    no target)."""
 
     rid: Any
     prompt: list[int]
@@ -137,6 +161,8 @@ class Request:
     top_k: int = 0
     eos_id: int | None = None
     tenant: str = ""
+    priority: int = 0
+    ttft_target_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -174,6 +200,25 @@ class _Live:
     # immutable shared pages).
     base: int = 0
     floor: int = 0
+    # Preemption state (ISSUE 12): ``feed`` = the token sequence to
+    # (re-)prefill — ``None`` until a preemption parks the request, then
+    # prompt + generated-so-far tokens (the resume prefill's last row IS
+    # the decode tick the eviction displaced, which is what makes the
+    # resumed greedy output bit-match). ``preempts`` bounds thrash.
+    feed: list | None = None
+    preempts: int = 0
+
+    def feed_tokens(self) -> list:
+        """What prefill feeds the device: the prompt, or the resume
+        sequence after a preemption."""
+        return self.feed if self.feed is not None else self.req.prompt
+
+    def remaining_new(self) -> int:
+        """Output tokens still owed — the page requirement's generation
+        term (full ``max_new_tokens`` before the first token; the
+        resume admission re-plans with the already-generated tokens
+        moved into the feed, so the page watermark is unchanged)."""
+        return self.req.max_new_tokens - len(self.tokens)
 
     def cache_fill(self) -> int:
         """Host mirror of the device cache fill for a LIVE slot — THE
@@ -207,10 +252,21 @@ class Server:
     """
 
     def __init__(self, engine, *, sentinel=None, stream=None, slo=None,
-                 max_queue=None):
+                 max_queue=None, policy=None):
         self.engine = engine
         self.sentinel = sentinel
+        self.policy = policy
+        if policy is not None and stream is None:
+            # The policy's projected-TTFT estimator reads rolling
+            # prefill/decode tick windows — when the caller didn't wire
+            # a registry, a private one keeps admission evidence-based
+            # instead of silently disabled.
+            from mpit_tpu.obs.stream import StreamRegistry
+
+            stream = StreamRegistry()
         self.stream = stream
+        if policy is not None:
+            policy.bind_registry(stream)
         self.slo = slo
         if slo is not None and stream is None:
             raise ValueError(
@@ -253,6 +309,7 @@ class Server:
         self.free: list[int] = list(range(engine.slots))[::-1]  # pop() = slot 0 first
         self.completed: list[Completed] = []
         self.shed: list[Request] = []
+        self.shed_causes: dict[str, int] = {}  # cause -> count (ISSUE 12)
         self.tick = 0
         self.admissions = 0
         self._occupancy_sum = 0.0
@@ -278,11 +335,18 @@ class Server:
         )
 
     def submit(self, req: Request) -> bool:
-        """Enqueue one request; returns False when ``max_queue`` shed
-        it instead (malformed requests still raise — shedding is a
-        LOAD decision, validation is a caller bug)."""
+        """Enqueue one request; returns False when it was SHED instead
+        — ``max_queue`` bounded intake (``shed_queue_full``) or the
+        policy's projected-TTFT admission verdict (``shed_admission``)
+        (malformed requests still raise — shedding is a LOAD decision,
+        validation is a caller bug)."""
         if not req.prompt:
             raise ValueError(f"request {req.rid!r}: empty prompt")
+        if req.priority < 0:
+            raise ValueError(
+                f"request {req.rid!r}: priority must be >= 0 (0 = "
+                f"highest tier), got {req.priority}"
+            )
         if req.max_new_tokens < 1:
             raise ValueError(
                 f"request {req.rid!r}: max_new_tokens must be >= 1 "
@@ -328,16 +392,62 @@ class Server:
             # SLO is shed/arrivals, so both sides of the ratio must see
             # every request that showed up.
             self.stream.inc("serve_arrivals")
-        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+        # Two distinct shed causes (ISSUE 12 satellite) — bounded intake
+        # vs the policy's projected-TTFT verdict — kept apart in the
+        # cause-suffixed counters/instants/stats so breach forensics can
+        # tell "queue physically full" from "queueing would only
+        # manufacture a guaranteed SLO miss". ``serve_shed`` stays the
+        # TOTAL: the shed-rate SLO numerator covers both causes.
+        cause = None
+        if self.max_queue is not None and self._qdepth() >= self.max_queue:
+            cause = "queue_full"
+        elif self.policy is not None and self.policy.should_shed(req):
+            cause = "admission"
+            self.policy.shed_admission += 1
+        if cause is not None:
             self.shed.append(req)
+            self.shed_causes[cause] = self.shed_causes.get(cause, 0) + 1
             obs.counter("serve_shed")
-            obs.instant("request_shed", queue_depth=len(self.queue),
+            obs.counter(f"serve_shed_{cause}")
+            obs.instant("request_shed", cause=cause,
+                        queue_depth=self._qdepth(),
                         **self._span_attrs(req))
             if self.stream is not None:
                 self.stream.inc("serve_shed")
+                self.stream.inc(f"serve_shed_{cause}")
             return False
-        self.queue.append(_Live(req, time.perf_counter()))
+        self._enqueue(_Live(req, time.perf_counter()))
         return True
+
+    # -- queue plumbing (FIFO deque vs policy tier) --------------------------
+    def _enqueue(self, live: _Live) -> None:
+        if self.policy is not None:
+            self.policy.enqueue(live)
+        else:
+            self.queue.append(live)
+
+    def _qdepth(self) -> int:
+        return (
+            self.policy.pending()
+            if self.policy is not None
+            else len(self.queue)
+        )
+
+    def _next_queued(self) -> _Live | None:
+        """Pop the next request to admit — FIFO order, or the policy's
+        tier-then-deficit-round-robin choice."""
+        if self.policy is not None:
+            return self.policy.next()
+        return self.queue.popleft() if self.queue else None
+
+    def _restore_queued(self, live: _Live) -> None:
+        """Undo one pop (the admission attempt found no pages): back to
+        the queue head, order preserved (the policy also refunds the
+        spent DRR credit)."""
+        if self.policy is not None:
+            self.policy.restore(live)
+        else:
+            self.queue.appendleft(live)
 
     # -- the loop -----------------------------------------------------------
     def _admit(self) -> None:
@@ -350,56 +460,136 @@ class Server:
             self._admit_dense()
 
     def _admit_paged(self) -> None:
-        """Paged admission (ISSUE 7): FIFO — grant the head of the
-        queue a free slot AND its whole page requirement (fresh pages +
-        shared-prefix mappings + COW reserve, all-or-nothing in the
-        allocator) or stop. Stopping on the first request that doesn't
-        fit keeps admission fair: a stream of small requests cannot
-        starve a big one indefinitely. Admitted requests enter
-        ``prefilling``; :meth:`_prefill_chunk_tick` feeds their prompt
-        ``prefill_chunk`` tokens per tick."""
+        """Paged admission (ISSUE 7): grant the next queued request
+        (FIFO head, or the policy's tier/DRR choice) a free slot AND
+        its whole page requirement (fresh pages + shared-prefix
+        mappings + COW reserve, all-or-nothing in the allocator) or
+        stop. Stopping on the first request that doesn't fit keeps
+        admission fair: a stream of small requests cannot starve a big
+        one indefinitely. Admitted requests enter ``prefilling``;
+        :meth:`_prefill_chunk_tick` feeds their prompt
+        ``prefill_chunk`` tokens per tick.
+
+        With a policy (ISSUE 12), a capacity miss — no free slot, or no
+        pages for the chosen request — may PREEMPT instead of stopping:
+        when the best queued tier's head is projected to miss its TTFT
+        target, a lower-tier live generation is parked (pages freed,
+        tokens kept host-side) and the loop retries. Each preemption
+        frees one victim; termination is bounded by the live set and
+        per-request ``max_preemptions``."""
         alloc = self.engine.allocator
         now = time.perf_counter()
-        while self.queue and self.free:
-            live = self.queue[0]
+        while True:
+            if not self.free:
+                if not self._try_preempt(now):
+                    break
+                continue  # a slot (and its victim's pages) just freed
+            live = self._next_queued()
+            if live is None:
+                break
             slot = self.free[-1]
-            plan = alloc.admit(
-                slot, live.req.prompt, live.req.max_new_tokens
-            )
+            feed = live.feed_tokens()
+            plan = alloc.admit(slot, feed, live.remaining_new())
             if plan is None:
-                # Pool full RIGHT NOW (nothing was taken) — retry after
-                # a retirement frees pages; the queue keeps its order.
-                # Instant only on the TRANSITION into exhaustion: a
-                # sustained overload would otherwise write one instant
-                # per tick into the Recorder's bounded buffer, evicting
-                # the spans the percentiles and the obs diff gate read.
+                # Pool full RIGHT NOW (nothing was taken) — back to the
+                # queue head; retry after a retirement (or a preemption)
+                # frees pages. Instant only on the TRANSITION into
+                # exhaustion: a sustained overload would otherwise write
+                # one instant per tick into the Recorder's bounded
+                # buffer, evicting the spans the percentiles and the
+                # obs diff gate read.
+                self._restore_queued(live)
+                if self._try_preempt(now):
+                    continue  # freed pages; the restored head retries
                 if not self._pool_exhausted:
                     self._pool_exhausted = True
                     obs.instant(
                         "kv_pool_exhausted",
                         free_pages=alloc.free_pages,
-                        queued=len(self.queue),
+                        queued=self._qdepth(),
                     )
                 break
-            self.queue.popleft()
             self.free.pop()
             self._pool_exhausted = False  # an admit fit: episode over
             # The write floor is the shared-token count; the forward
-            # re-runs at least the LAST prompt token (its logits seed
-            # the first output token), so the feed base is capped one
-            # below the prompt end even on a full-prompt prefix hit.
+            # re-runs at least the LAST feed token (its logits seed
+            # the next output token), so the feed base is capped one
+            # below the feed end even on a full-feed prefix hit.
             live.floor = plan.shared_tokens
-            live.base = min(plan.shared_tokens, len(live.req.prompt) - 1)
+            live.base = min(plan.shared_tokens, len(feed) - 1)
             self._temp[slot] = live.req.temperature
             self._topk[slot] = live.req.top_k
-            obs.span_at(
-                "queue_wait", live.submit_t, now,
-                **self._span_attrs(live.req),
-            )
-            if self.stream is not None:
-                self.stream.observe("queue_wait", now - live.submit_t)
+            if live.tokens:
+                # Resumed after a preemption: queue_wait/TTFT were
+                # already delivered in the first stint — re-recording
+                # them would double-count the request in the histograms.
+                self.policy.resumes += 1
+                obs.instant(
+                    "request_resumed", generated=len(live.tokens),
+                    **self._span_attrs(live.req),
+                )
+            else:
+                obs.span_at(
+                    "queue_wait", live.submit_t, now,
+                    **self._span_attrs(live.req),
+                )
+                if self.stream is not None:
+                    self.stream.observe("queue_wait", now - live.submit_t)
             self.prefilling[slot] = live
             self.admissions += 1
+
+    # -- preemption (ISSUE 12, paged engines only) ---------------------------
+    def _try_preempt(self, now: float) -> bool:
+        """Park one lower-tier live generation when the policy says the
+        best queued tier's head would otherwise miss its TTFT target.
+        Returns True when a victim was evicted (a slot + its pages are
+        now free)."""
+        if self.policy is None or not self._paged:
+            return False
+        priority = self.policy.wants_preemption(now)
+        if priority is None:
+            return False
+        victim = self.policy.pick_victim(self.live, priority)
+        if victim is None:
+            return False
+        self._preempt(victim, for_tier=priority)
+        return True
+
+    def _preempt(self, slot: int, *, for_tier: int | None = None) -> None:
+        """Evict ``slot``'s live request: free its pages back to the
+        allocator (sole-owner pages return to the free list, shared
+        pages drop a refcount — exactly what retirement would free, the
+        pool-accounting pin), park the request host-side with its
+        generated-so-far tokens as the resume feed, and re-queue it at
+        the FRONT of its own tier. The resume path is the normal
+        chunked prefill over ``prompt + tokens`` — its final row
+        recomputes the displaced decode tick, so the resumed greedy
+        output bit-matches the un-preempted one (test-pinned)."""
+        live = self.live.pop(slot)
+        alloc = self.engine.allocator
+        owned, shared = alloc.slot_page_stats(slot)
+        alloc.free_slot(slot)
+        self.free.append(slot)
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        live.preempts += 1
+        live.feed = list(live.req.prompt) + [int(t) for t in live.tokens]
+        live.base = 0
+        live.floor = 0
+        obs.counter("serve_preemptions")
+        obs.instant(
+            "request_preempted",
+            tier=live.req.priority,
+            for_tier=for_tier if for_tier is not None else -1,
+            generated=len(live.tokens),
+            pages_freed=owned,
+            pages_unshared=shared,
+            **self._span_attrs(live.req),
+        )
+        if self.stream is not None:
+            self.stream.inc("serve_preemptions")
+        self.policy.preemptions += 1
+        self.policy.requeue_front(live)
 
     def _prefill_chunk_tick(self) -> None:
         """Advance every prefilling slot by ONE prompt chunk (one
@@ -420,7 +610,7 @@ class Server:
         finishing: list[tuple[int, _Live]] = []
         now = time.perf_counter()
         for slot, live in self.prefilling.items():
-            p = live.req.prompt
+            p = live.feed_tokens()
             n = min(w, len(p) - live.base)
             # First write of this chunk: at the floor on a partial-page
             # prefix hit, else at the feed base. A write landing in a
@@ -454,28 +644,51 @@ class Server:
         t_first = time.perf_counter()
         if self.sentinel is not None:
             self.sentinel.observe_phases(self.tick, prefill=t_first - now)
+        if self.stream is not None:
+            # The policy projector's per-chunk cost basis (ISSUE 12).
+            self.stream.observe("prefill_tick", t_first - now)
         for slot in self.prefilling:
             self.prefilling[slot].base += int(chunk_lens[slot])
         for slot, live in finishing:
             del self.prefilling[slot]
-            alloc.register_prefix(slot, live.req.prompt)
-            live.first_token_t = t_first
-            live.tokens = [int(first[slot])]
-            obs.span_at(
-                "request_ttft", live.submit_t, t_first,
-                **self._span_attrs(live.req),
-            )
-            if self.stream is not None:
-                self.stream.observe(
-                    "request_ttft", t_first - live.submit_t
-                )
+            alloc.register_prefix(slot, live.feed_tokens())
+            if live.tokens:
+                # Resumed after a preemption: this chunk's sampled
+                # token IS the decode step the eviction displaced —
+                # append it; TTFT was already delivered before the park.
+                live.tokens.append(int(first[slot]))
+            else:
+                live.first_token_t = t_first
+                live.tokens = [int(first[slot])]
+                self._record_ttft(live, t_first)
             self.live[slot] = live
             self._maybe_retire(slot, t_first)
 
+    def _record_ttft(self, live: _Live, t_first: float) -> None:
+        """First-token bookkeeping: the request_ttft span + rolling
+        windows, plus the per-tier series (``request_ttft_tier<p>`` —
+        what a tier-scoped SLO target reads) when tiers are in play and
+        the per-tenant series behind ``stats()``'s tenant roll-up."""
+        req = live.req
+        obs.span_at(
+            "request_ttft", live.submit_t, t_first,
+            **self._span_attrs(req),
+        )
+        if self.stream is None:
+            return
+        ttft = t_first - live.submit_t
+        self.stream.observe("request_ttft", ttft)
+        if self.policy is not None or req.priority or req.ttft_target_s > 0:
+            self.stream.observe(f"request_ttft_tier{req.priority}", ttft)
+        if req.tenant:
+            self.stream.observe(f"request_ttft_tenant:{req.tenant}", ttft)
+
     def _admit_dense(self) -> None:
         """Move queued requests into free slots and prefill them (one
-        batched call however many were admitted this tick)."""
-        if not self.queue or not self.free:
+        batched call however many were admitted this tick) — FIFO
+        order, or the policy's tier/DRR order (no preemption on the
+        dense engine: a slot has no pages to free)."""
+        if not self._qdepth() or not self.free:
             return
         s, plen = self.engine.slots, self.engine.prefill_len
         tokens = np.zeros((s, plen), np.int32)
@@ -483,8 +696,10 @@ class Server:
         admit = np.zeros((s,), bool)
         batch: list[tuple[int, _Live]] = []
         now = time.perf_counter()
-        while self.queue and self.free:
-            live = self.queue.popleft()
+        while self.free:
+            live = self._next_queued()
+            if live is None:
+                break
             slot = self.free.pop()
             p = live.req.prompt
             tokens[slot, : len(p)] = p
@@ -516,17 +731,12 @@ class Server:
             self.sentinel.observe_phases(
                 self.tick, prefill=t_first - now
             )
+        if self.stream is not None:
+            self.stream.observe("prefill_tick", t_first - now)
         for slot, live in batch:
             live.first_token_t = t_first
             live.tokens = [int(first[slot])]
-            obs.span_at(
-                "request_ttft", live.submit_t, t_first,
-                **self._span_attrs(live.req),
-            )
-            if self.stream is not None:
-                self.stream.observe(
-                    "request_ttft", t_first - live.submit_t
-                )
+            self._record_ttft(live, t_first)
             self.live[slot] = live
             self._maybe_retire(slot, t_first)
 
@@ -608,6 +818,8 @@ class Server:
         obs.counter("serve_tokens", float(active.sum()))
         if self.stream is not None:
             self.stream.inc("serve_tokens", float(active.sum()))
+            # The policy projector's decode-tick term (ISSUE 12).
+            self.stream.observe("decode_tick", now - t0)
         lens = np.asarray(
             [live.cache_fill() for live in self.live.values()]
         )
@@ -660,9 +872,10 @@ class Server:
             self._maybe_retire(slot, now)
 
     def _pending(self) -> bool:
-        """Work outstanding: queued, mid-prefill (paged chunking) or
-        live — the loop-termination and truncation predicate."""
-        return bool(self.queue or self.prefilling or self.live)
+        """Work outstanding: queued (FIFO deque or policy tiers),
+        mid-prefill (paged chunking) or live — the loop-termination and
+        truncation predicate."""
+        return bool(self._qdepth() or self.prefilling or self.live)
 
     def _kv_gauges(self) -> None:
         """Cache-memory efficiency gauges (ISSUE 7 satellite):
@@ -704,7 +917,17 @@ class Server:
         obs.gauge("slot_occupancy", occupancy)
         if self.stream is not None:
             self.stream.set_gauge("slot_occupancy", occupancy)
-            self.stream.set_gauge("queue_depth", float(len(self.queue)))
+            self.stream.set_gauge("queue_depth", float(self._qdepth()))
+        if self.policy is not None:
+            # Per-tier backlog (ISSUE 12): one gauge per tier the run
+            # has seen — zeros included, so an emptied tier reads 0,
+            # not its last nonzero value.
+            for tier, depth in self.policy.tier_depths().items():
+                obs.gauge(f"queue_depth_tier{tier}", float(depth))
+                if self.stream is not None:
+                    self.stream.set_gauge(
+                        f"queue_depth_tier{tier}", float(depth)
+                    )
         self._kv_gauges()
         if self.live:
             self._decode_tick()
@@ -798,6 +1021,30 @@ class Server:
         return self.completed
 
     # -- reporting ----------------------------------------------------------
+    def _tenant_rollup(self) -> dict:
+        """Per-tenant serving facts (ISSUE 12 satellite): completions,
+        sheds, and the whole-run p95 TTFT from the stream registry's
+        per-tenant sketch — the measurable surface the fairness
+        invariant is checked against (tenants were previously only span
+        labels). Empty when no request carried a tenant."""
+        out: dict[str, dict] = {}
+        for c in self.completed:
+            if not c.tenant:
+                continue
+            e = out.setdefault(c.tenant, {"completed": 0, "shed": 0})
+            e["completed"] += 1
+        for r in self.shed:
+            if not r.tenant:
+                continue
+            e = out.setdefault(r.tenant, {"completed": 0, "shed": 0})
+            e["shed"] += 1
+        if self.stream is not None:
+            for t, e in out.items():
+                sk = self.stream.total_sketch(f"request_ttft_tenant:{t}")
+                if sk is not None and sk.count:
+                    e["ttft_p95_s"] = round(sk.quantile(0.95), 6)
+        return dict(sorted(out.items()))
+
     def stats(self) -> dict:
         """Host-side serving roll-up (the obs summary carries the
         span-derived histograms; this is the request-math view)."""
@@ -844,6 +1091,17 @@ class Server:
             )
         if self.shed:
             out["requests_shed"] = len(self.shed)
+            # Cause split (ISSUE 12 satellite): bounded intake vs the
+            # projected-TTFT admission verdict, never conflated.
+            for cause, n in sorted(self.shed_causes.items()):
+                out[f"requests_shed_{cause}"] = n
+        if self.policy is not None:
+            pol = self.policy.stats()
+            out["preemptions"] = pol["preemptions"]
+            out["policy"] = pol
+        tenants = self._tenant_rollup()
+        if tenants:
+            out["tenants"] = tenants
         if done:
             lat = np.asarray([c.latency_s for c in done])
             ttft = np.asarray([c.ttft_s for c in done])

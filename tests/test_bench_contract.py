@@ -279,6 +279,49 @@ def _realistic_results():
                 "instants": {"slo_breach": 12, "slo_recovered": 9},
             },
         },
+        # ISSUE 12: the policy A/B's headline triple rides the line;
+        # the FIFO counterparts, curve, calibration and geometry are
+        # detail-only. Worst-case widths throughout.
+        "gpt2_policy": {
+            "max_sustained_req_per_s_policy": 1234.56,
+            "max_sustained_req_per_s_fifo": 123.45,
+            "interactive_ttft_p95_ms": 1234.56,
+            "interactive_ttft_p95_ms_fifo": 12345.67,
+            "preemptions": 123,
+            "ttft_target_s": 0.234567,
+            "slo_breaches": {"fifo": 12, "policy": 12},
+            "decode_attention": "reference",
+            "calibration": {
+                "unloaded_ttft_s": 0.002083,
+                "ttft_multiple": 10.0,
+                "closed_loop_capacity_req_per_s": 230.57,
+            },
+            "rate_sweep": [
+                {"rate_fraction": f, "offered_req_per_s": 123.45,
+                 **{mode: {"completed_req_per_s": 120.12,
+                           "interactive_ttft_p95_s": 1.234567,
+                           "batch_ttft_p95_s": 1.234567,
+                           "tokens_per_sec": 1234.5, "breaches": 3,
+                           "breach_fraction": 0.9599,
+                           "shed_fraction": 0.2124, "truncated": True,
+                           "sustained": False, "sentinel_clean": False}
+                    for mode in ("fifo", "policy")}}
+                for f in (0.4, 0.7, 1.0, 1.6)
+            ],
+            "geometry": {"num_layers": 2, "d_model": 64, "slots": 4,
+                         "max_len": 64, "prefill_len": 32,
+                         "kv_pages": 20, "kv_page_size": 8,
+                         "prefill_chunk": 8, "duration_s": 2.0,
+                         "window_s": 1.5, "tenants": 2,
+                         "mix": "interactive 0.8 p0 / batch 0.2 p1"},
+            "phases": phases,
+            "obs_baseline": {
+                **obs_baseline,
+                "instants": {"slo_breach": 12, "slo_recovered": 9,
+                             "request_preempted": 33,
+                             "request_resumed": 33},
+            },
+        },
         # ISSUE 11: the elastic tier's robustness triple rides the
         # line; fleet geometry and the per-scenario evidence blocks
         # (straggler skew, kill/rejoin lifecycle) are detail-only.
@@ -423,12 +466,11 @@ class TestLineBudget:
         assert "decode_hbm_gbps_modeled" not in serve
         assert "roofline_platform" not in serve
         assert serve["latency_p95_s"] == 2.345678
-        assert serve["latency_p95_s"] == 2.345678
-        # ISSUE 7: the paged-cache headline triple rides the line —
-        # max concurrency at the fixed HBM budget, the prefix-hit rate
-        # behind it, and the page size defining both; the full
-        # capacity-sweep and chunked-prefill A/B blocks are detail-only.
-        assert serve["kv_page_size"] == 16
+        # ISSUE 7: the paged-cache headline pair rides the line —
+        # max concurrency at the fixed HBM budget and the prefix-hit
+        # rate behind it; the full capacity-sweep and chunked-prefill
+        # A/B blocks are detail-only (kv_page_size, static geometry,
+        # moved detail-only to pay for ISSUE 12's gpt2_policy triple).
         assert serve["prefix_hit_rate"] == 0.9792
         assert serve["max_concurrent_at_hbm"] == 128
         # latency_p50_s and slots moved detail-only to pay for the
@@ -439,22 +481,43 @@ class TestLineBudget:
                         "prompt_len", "ticks", "decode_sweep",
                         "decode_sampler", "paged_capacity",
                         "chunked_prefill", "latency_p50_s", "slots",
+                        "kv_page_size",
                         "reference_decode_tokens_per_sec"):
             assert off_line not in serve
-        # The SLO sweep (ISSUE 6): the headline triple — max sustained
-        # req/s at p95 TTFT ≤ target, the target defining it, and the
-        # breach count proving the ladder crossed saturation — rides
-        # the line; the rate→(TTFT, tok/s, breach) curve, calibration
-        # basis, geometry and engine mode are detail-file-only. To keep
-        # the ≤1.2k budget, gpt2_moe's dispatch label and gpt2_serve's
-        # request count also moved detail-only.
+        # The SLO sweep (ISSUE 6): max sustained req/s at p95 TTFT ≤
+        # target plus the breach count proving the ladder crossed
+        # saturation ride the line; the rate→(TTFT, tok/s, breach)
+        # curve, calibration basis (incl. ttft_target_s — moved
+        # detail-only for ISSUE 12), geometry and engine mode are
+        # detail-file-only. To keep the ≤1.2k budget, gpt2_moe's
+        # dispatch label and gpt2_serve's request count also moved
+        # detail-only.
         slo = rec["detail"]["gpt2_slo"]
         assert slo["max_sustained_req_per_s"] == 123.45
-        assert slo["ttft_target_s"] == 0.234567
         assert slo["slo_breaches"] == 12
         for off_line in ("rate_sweep", "calibration", "geometry",
-                         "decode_attention", "slots"):
+                         "decode_attention", "slots", "ttft_target_s"):
             assert off_line not in slo
+        # ISSUE 12: the policy A/B triple rides the line — the policy's
+        # max sustained rate (the FIFO counterpart it must beat is in
+        # detail for the comparison), the interactive-tier p95 at the
+        # top swept rate, and the preemption count proving the eviction
+        # path ran. Everything else — the FIFO numbers, curve,
+        # calibration, target, geometry — is detail-file-only.
+        pol = rec["detail"]["gpt2_policy"]
+        assert pol["max_sustained_req_per_s_policy"] == 1234.56
+        assert pol["interactive_ttft_p95_ms"] == 1234.56
+        assert pol["preemptions"] == 123
+        for off_line in ("max_sustained_req_per_s_fifo",
+                         "interactive_ttft_p95_ms_fifo", "rate_sweep",
+                         "calibration", "geometry", "ttft_target_s",
+                         "slo_breaches", "decode_attention"):
+            assert off_line not in pol
+        # The final_loss echoes that paid for the triple are off the
+        # line everywhere (values verbatim in BENCH_DETAIL.json; the
+        # convergence pins live in tests).
+        for wl in ("alexnet", "resnet50", "gpt2"):
+            assert "final_loss" not in rec["detail"][wl]
         assert "dispatch" not in rec["detail"]["gpt2_moe"]
         assert "requests" not in rec["detail"]["gpt2_serve"]
         # ISSUE 11: the elastic tier's robustness triple rides the
@@ -560,3 +623,61 @@ class TestSLOArtifact:
         # written when events dropped — a truncated recording would make
         # `obs diff` refuse to gate on this snapshot, exit 2).
         assert base.get("dropped_events", 0) == 0
+
+
+class TestPolicyArtifact:
+    """ISSUE 12 acceptance, pinned against the committed artifact: the
+    gpt2_policy FIFO-vs-policy sweep must show the policy ≥ FIFO on max
+    sustained req/s at p95 TTFT ≤ target AND a lower interactive-tier
+    p95 under the mixed 80/20 trace, with preemptions actually having
+    run and the sentinel wired to the SLO monitor (breach instants in
+    the gate snapshot)."""
+
+    def _entry(self):
+        from pathlib import Path
+
+        detail = json.loads(
+            (Path(bench.__file__).parent / "BENCH_DETAIL.json").read_text()
+        )
+        assert "gpt2_policy" in detail["workloads"], (
+            "BENCH_DETAIL.json has no gpt2_policy entry — re-run "
+            "`python bench.py` (or the standalone gpt2_policy run)"
+        )
+        return detail["workloads"]["gpt2_policy"]
+
+    def test_policy_beats_fifo_on_sustained_rate(self):
+        e = self._entry()
+        pol = e["max_sustained_req_per_s_policy"]
+        fifo = e["max_sustained_req_per_s_fifo"]
+        assert pol is not None
+        assert fifo is None or pol >= fifo
+
+    def test_interactive_p95_lower_under_policy(self):
+        e = self._entry()
+        assert e["interactive_ttft_p95_ms"] is not None
+        assert e["interactive_ttft_p95_ms_fifo"] is not None
+        assert (
+            e["interactive_ttft_p95_ms"] < e["interactive_ttft_p95_ms_fifo"]
+        )
+
+    def test_preemption_actually_ran(self):
+        e = self._entry()
+        assert e["preemptions"] >= 1
+        # ... and the instants made it into the gate snapshot — the
+        # eviction path is attributable from the recorded flight data.
+        inst = e["obs_baseline"]["instants"]
+        assert inst.get("request_preempted", 0) >= 1
+        assert inst.get("request_resumed", 0) >= 1
+
+    def test_sentinel_flagged_breaches_during_sweep(self):
+        e = self._entry()
+        # The ladder's top rungs overload by construction; the tier-0
+        # SLO monitor fed the per-run sentinel, and the breach instants
+        # ride the unclipped gate snapshot.
+        assert sum(e["slo_breaches"].values()) >= 1
+        base = e["obs_baseline"]
+        assert base["instants"].get("slo_breach", 0) >= 1
+        assert base.get("dropped_events", 0) == 0
+        top = e["rate_sweep"][-1]
+        assert top["policy"]["sentinel_clean"] is False
+        assert top["fifo"]["breaches"] >= 1 or top["fifo"]["truncated"]
