@@ -1032,6 +1032,173 @@ def _chunked_prefill_block(prefill_chunk: int = 32):
     }
 
 
+def _speculative_block(
+    spec_k: int = 3, draft_layers: int = 1, contexts: tuple = (16, 48),
+    train_steps: int = 300,
+):
+    """Speculative-decode A/B (ISSUE 13): the SAME seeded request trace
+    through the same engine geometry, spec on vs off, at acceptance
+    rates the trace ACTUALLY ACHIEVES — both ends of the bracket:
+
+    - ``trained``: target (4 layers) and draft (``draft_layers``)
+      trained to convergence on a memorizable synthetic stream, the
+      regime speculation exists for (the draft genuinely predicts the
+      target — greedy continuations agree, acceptance is high, and the
+      tokens/s improvement is real);
+    - ``random_draft``: the same geometry with a random-init target and
+      its layer-truncated self-draft (``serve.weights.
+      draft_from_target``) — the floor: near-zero acceptance, so every
+      tick pays draft + verify for ~1 token and speculation LOSES.
+      Recording the loss is the point; a draft that cannot predict the
+      target should never be shipped, and the bench must say what that
+      costs rather than hide it.
+
+    On CPU these are acceptance/tokens-per-tick/relative-cost facts
+    with honest wall clocks — never a chip-speedup claim (the record's
+    top-level platform label governs, per BENCHMARKS.md discipline).
+    Reduced geometry (vocab 256, d_model 128) keeps the block inside
+    the bench budget; the A/B signal is relative cost at achieved
+    acceptance, not an absolute rate — geometry rides the entry."""
+    import dataclasses
+
+    import numpy as np
+    import optax
+
+    from mpit_tpu import obs
+    from mpit_tpu.models import GPT2, GPT2Config
+    from mpit_tpu.opt.goo import goo_adam
+    from mpit_tpu.serve import (
+        Engine,
+        Request,
+        Server,
+        draft_from_target,
+        warm_engine,
+    )
+
+    cfg = GPT2Config(
+        vocab_size=256, max_seq_len=128, num_layers=4, num_heads=4,
+        d_model=128, head_dtype=jnp.bfloat16,
+    )
+    dcfg = dataclasses.replace(cfg, num_layers=draft_layers)
+    slots, max_new, requests = 4, 12, 8
+    rng = np.random.RandomState(17)
+    # The memorizable stream: one fixed token sequence; every prompt is
+    # a prefix of it, so the trained pair's greedy continuations are
+    # the stream itself — the high-agreement regime.
+    stream = rng.randint(0, cfg.vocab_size, size=96).tolist()
+    batch = jnp.asarray([stream[:65]], jnp.int32)
+
+    def _train(mcfg, seed):
+        model = GPT2(mcfg)
+        params = jax.jit(model.init)(
+            jax.random.key(seed), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        opt = goo_adam(3e-3)
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state):
+            loss, grads = jax.value_and_grad(
+                lambda p: GPT2.fused_loss_fn(model, p, batch)
+            )(params)
+            updates, state = opt.update(grads, state, params)
+            return optax.apply_updates(params, updates), state, loss
+
+        loss = None
+        for _ in range(train_steps):
+            params, state, loss = step(params, state)
+        return params, float(loss)
+
+    rec = obs.get_recorder()
+
+    def _measure_pair(tparams, dparams, draft_cfg):
+        plain = Engine(cfg, tparams, slots=slots, max_len=128,
+                       prefill_len=max(contexts))
+        spec = Engine(cfg, tparams, slots=slots, max_len=128,
+                      prefill_len=max(contexts), spec_k=spec_k,
+                      draft_params=dparams, draft_cfg=draft_cfg)
+        warm_engine(plain)
+        warm_engine(spec)
+
+        def _stream_run(engine, ctx):
+            engine.reset()
+            server = Server(engine)
+            for i in range(requests):
+                plen = ctx - (i % 3)  # same trace both ways, mild skew
+                server.submit(Request(
+                    rid=i, prompt=stream[:plen], max_new_tokens=max_new,
+                ))
+            n0 = rec.event_count() if rec else 0
+            t0 = time.perf_counter()
+            server.run()
+            wall = time.perf_counter() - t0
+            st = server.stats()
+            dtok = st["generated_tokens"] - st["requests_completed"]
+            ds = wall
+            if rec is not None:
+                ph = rec.summary(since=n0)["phases"]
+                ds = ph.get("decode", {}).get("total_s", wall)
+            return st, (dtok / ds if ds else None)
+
+        points = []
+        for ctx in contexts:
+            p_st, p_tps = _stream_run(plain, ctx)
+            s_st, s_tps = _stream_run(spec, ctx)
+            points.append({
+                "context_len": ctx,
+                "decode_tokens_per_sec": (
+                    round(p_tps, 1) if p_tps else None
+                ),
+                "spec_decode_tokens_per_sec": (
+                    round(s_tps, 1) if s_tps else None
+                ),
+                "spec_speedup": (
+                    round(s_tps / p_tps, 3) if p_tps and s_tps else None
+                ),
+                "accepted_tokens_per_tick": s_st.get(
+                    "accepted_tokens_per_tick"
+                ),
+                "draft_acceptance_rate": s_st.get(
+                    "draft_acceptance_rate"
+                ),
+                "ttft_p95_delta_s": (
+                    round(s_st["ttft_p95_s"] - p_st["ttft_p95_s"], 6)
+                    if "ttft_p95_s" in s_st and "ttft_p95_s" in p_st
+                    else None
+                ),
+            })
+        return points
+
+    with obs.span("speculative_ab"):
+        tparams, t_loss = _train(cfg, seed=5)
+        dparams_t, d_loss = _train(dcfg, seed=6)
+        trained_points = _measure_pair(tparams, dparams_t, dcfg)
+        rnd = jax.jit(GPT2(cfg).init)(
+            jax.random.key(7), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        rnd_draft, rnd_dcfg = draft_from_target(rnd, cfg, draft_layers)
+        random_points = _measure_pair(rnd, rnd_draft, rnd_dcfg)
+    att = [p["accepted_tokens_per_tick"] for p in trained_points
+           if p["accepted_tokens_per_tick"] is not None]
+    return {
+        "geometry": dict(
+            vocab=cfg.vocab_size, d_model=cfg.d_model,
+            num_layers=cfg.num_layers, slots=slots, max_len=128,
+            max_new=max_new, requests=requests, spec_k=spec_k,
+            draft_layers=draft_layers, train_steps=train_steps,
+        ),
+        "trained": {
+            "target_final_loss": round(t_loss, 4),
+            "draft_final_loss": round(d_loss, 4),
+            "points": trained_points,
+        },
+        "random_draft": {"points": random_points},
+        "accepted_tokens_per_tick": (
+            round(sum(att) / len(att), 4) if att else None
+        ),
+    }
+
+
 def bench_gpt2_serve(
     slots: int = 8,
     prompt_len: int = 64,
@@ -1237,6 +1404,13 @@ def bench_gpt2_serve(
     ]
     out["max_concurrent_at_hbm"] = out["paged_capacity"]["paged"][
         "max_concurrent"
+    ]
+    # ISSUE 13: the speculative-decode A/B (same seeded traces, spec
+    # on/off, self-speculation draft). The block stays detail-only; the
+    # achieved tokens-per-slot-tick multiplier rides the record line.
+    out["speculative"] = _speculative_block()
+    out["accepted_tokens_per_tick"] = out["speculative"][
+        "accepted_tokens_per_tick"
     ]
     return out
 
@@ -2087,14 +2261,17 @@ _LINE_KEYS = {
     # max concurrent requests at the fixed HBM budget, the prefix-hit
     # rate behind it, and the page size defining both; the capacity and
     # chunked-prefill blocks stay detail-only.
-    # decode_hbm_util_pct + engine_compiles (ISSUE 8): the length-aware
-    # achieved-bandwidth verdict (visited-tile bytes, not padded
-    # cost_analysis) and the pinned engine-lifetime compile count. To
-    # pay for them, latency_p50_s (the SLO-relevant p95 stays) and the
-    # static slots geometry moved detail-only.
+    # engine_compiles (ISSUE 8): the pinned engine-lifetime compile
+    # count. To pay for it, latency_p50_s (the SLO-relevant p95 stays)
+    # and the static slots geometry moved detail-only.
+    # accepted_tokens_per_tick (ISSUE 13): the speculative tokens-per-
+    # slot-tick multiplier from the A/B block (1.0 = plain decode);
+    # paid for by demoting decode_hbm_util_pct detail-only — it is
+    # EXACTLY derivable from detail keys (decode_hbm_gbps_modeled /
+    # the roofline_platform chip's HBM peak; null off-TPU anyway).
     "gpt2_serve": (
         "decode_tokens_per_sec", "decode_attention",
-        "decode_hbm_util_pct", "engine_compiles",
+        "engine_compiles", "accepted_tokens_per_tick",
         "latency_p95_s", "prefix_hit_rate",
         "max_concurrent_at_hbm", "error",
     ),

@@ -343,3 +343,184 @@ def lm_head_sample(
     sampled = jnp.where(top_k > 0, tk_tok, si)
     greedy = jnp.asarray(temperature, jnp.float32) <= 0.0
     return jnp.where(greedy, gi, sampled).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Blocked speculative verifier (ISSUE 13): score k drafted tokens + the
+# bonus position against the target distribution, streaming over vocab
+# blocks — the [rows, vocab] f32 logits never exist. Two passes over the
+# head blocks: pass A collects the statistics whose normalizers the
+# residual needs (greedy argmax, full-support logsumexp, top-k candidate
+# buffer, the drafted token's logit); pass B draws the full-vocab
+# residual sample with the finalized normalizer. The top-k residual
+# never needs pass B: the modified distribution's support lives entirely
+# inside the pass-A buffer.
+# ---------------------------------------------------------------------------
+
+
+def lm_head_verify(
+    h,
+    head,
+    drafted,
+    qprobs,
+    key,
+    temperature,
+    top_k,
+    *,
+    block_size: int = 8192,
+    k_cap: int = 128,
+    compute_dtype=jnp.float32,
+):
+    """Per-row verify quantities for exact speculative sampling.
+
+    Args:
+      h: ``[N, d_model]`` hidden rows — one per (slot, verify position),
+        N = slots × (k+1).
+      head: ``[vocab, d_model]`` LM-head / tied-embedding weight.
+      drafted: ``[N]`` int32 — the drafted token each row scored (any
+        value on bonus rows; their ``p_x`` is unused).
+      qprobs: ``[N, vocab]`` f32 draft probabilities (ZEROS on bonus
+        rows, making their residual a plain target sample).
+      key: PRNG key. The noise contract (shared bitwise with
+        :func:`mpit_tpu.serve.spec.verify_reference` at one vocab
+        block): block ``b`` draws ``gumbel(fold_in(key, b), (N,
+        block))``; the buffer residual draws ``gumbel(fold_in(key,
+        n_blocks), (N, k_cap))``.
+      temperature / top_k: ``[N]`` per-row modifications — the
+        ``lm_head_sample`` semantics (threshold at the k-th largest
+        logit inside the width-``k_cap`` buffer).
+
+    Returns ``(greedy [N] int32, p_x [N] f32, repl [N] int32)``:
+    target argmax (bit-matching ``lm_head_sample``'s greedy rule —
+    strict-``>`` first-max merge), the modified-target probability of
+    the drafted token, and the residual/bonus sample
+    (``norm(max(p − q, 0))`` via Gumbel-argmax).
+    """
+    vocab, d = head.shape
+    block = min(block_size, _round_up(vocab, 128))
+    pad = (-vocab) % block
+    if pad:
+        head = jnp.concatenate(
+            [head, jnp.zeros((pad, d), head.dtype)], axis=0
+        )
+        qprobs = jnp.concatenate(
+            [qprobs, jnp.zeros((qprobs.shape[0], pad), qprobs.dtype)],
+            axis=1,
+        )
+    n_blocks = head.shape[0] // block
+    head_blocks = head.reshape(n_blocks, block, head.shape[1])
+    offsets = jnp.arange(n_blocks, dtype=jnp.int32) * block
+    blk_ids = jnp.arange(n_blocks, dtype=jnp.int32)
+    n = h.shape[0]
+    kb = min(k_cap, vocab)
+    temp = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    drafted = jnp.asarray(drafted, jnp.int32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    cd = jnp.dtype(compute_dtype)
+
+    def tick_a(carry, xs):
+        gv, gi, m, s, tl, bv, bi = carry
+        head_b, off = xs
+        valid = off + jnp.arange(block, dtype=jnp.int32) < vocab
+        logits = _block_logits(h, head_b, valid, cd)  # [N, block] f32
+        # Greedy: strict > keeps the FIRST max — jnp.argmax's rule.
+        bm = jnp.max(logits, axis=-1)
+        bmi = jnp.argmax(logits, axis=-1).astype(jnp.int32) + off
+        upd = bm > gv
+        gv, gi = jnp.where(upd, bm, gv), jnp.where(upd, bmi, gi)
+        # Full-support logsumexp of logits/temp (padded cols: -big).
+        scaled = logits / temp[:, None]
+        sm = jnp.max(scaled, axis=-1)
+        m_new = jnp.maximum(m, sm)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(scaled - m_new[:, None]), axis=-1
+        )
+        # The drafted token's RAW logit, when this block covers it.
+        lt = drafted - off
+        in_blk = (lt >= 0) & (lt < block)
+        idx = jnp.clip(lt, 0, block - 1)
+        cand = jnp.take_along_axis(logits, idx[:, None], axis=1)[:, 0]
+        tl = jnp.where(in_blk, cand, tl)
+        # Running top-kb candidate buffer (raw logits + global indices).
+        cv, ci = lax.top_k(logits, min(kb, block))
+        allv = jnp.concatenate([bv, cv], axis=-1)
+        alli = jnp.concatenate([bi, ci + off], axis=-1)
+        bv, sel = lax.top_k(allv, kb)
+        bi = jnp.take_along_axis(alli, sel, axis=-1)
+        return (gv, gi, m_new, s, tl, bv, bi), None
+
+    neg = jnp.full((n,), -jnp.inf, jnp.float32)
+    zero_i = jnp.zeros((n,), jnp.int32)
+    init = (
+        neg, zero_i,  # greedy running (max, argmax)
+        neg, jnp.zeros((n,), jnp.float32),  # full-support lse (m, s)
+        jnp.full((n,), _NEG_BIG, jnp.float32),  # drafted token's logit
+        jnp.full((n, kb), _NEG_BIG, jnp.float32),  # top-k values
+        jnp.zeros((n, kb), jnp.int32),  # top-k global indices
+    )
+    (gv, gi, m, s, tl, bv, bi), _ = lax.scan(
+        tick_a, init, (head_blocks, offsets), unroll=min(n_blocks, 16)
+    )
+    lse_full = m + jnp.log(s)
+    kk = jnp.clip(top_k, 1, kb)
+    thresh = jnp.take_along_axis(bv, (kk - 1)[:, None], axis=1)[:, 0]
+    keep = bv >= thresh[:, None]
+    sc_b = bv / temp[:, None]
+    m_b = jnp.max(jnp.where(keep, sc_b, -jnp.inf), axis=1)
+    lse_topk = m_b + jnp.log(
+        jnp.sum(jnp.where(keep, jnp.exp(sc_b - m_b[:, None]), 0.0), axis=1)
+    )
+    p_x = jnp.where(
+        top_k > 0,
+        jnp.where(tl >= thresh, jnp.exp(tl / temp - lse_topk), 0.0),
+        jnp.exp(tl / temp - lse_full),
+    )
+    # Top-k residual: support ⊆ buffer, so the draw never leaves it.
+    q_b = jnp.take_along_axis(qprobs, bi, axis=1)
+    p_b = jnp.where(keep, jnp.exp(sc_b - lse_topk[:, None]), 0.0)
+    res_b = jnp.maximum(p_b - q_b, 0.0)
+    g_b = jax.random.gumbel(
+        jax.random.fold_in(key, n_blocks), (n, kb), jnp.float32
+    )
+    buf_tok = jnp.take_along_axis(
+        bi, jnp.argmax(jnp.log(res_b) + g_b, axis=1)[:, None], axis=1
+    )[:, 0]
+
+    # Pass B: full-vocab residual (top_k == 0 sampling rows) with the
+    # finalized normalizer — same blockwise matmul, fresh per-block
+    # Gumbel noise. Gated: greedy rows take the argmax replacement and
+    # top-k rows the buffer draw, so when NO row samples the full
+    # vocabulary the second head sweep is pure waste — skip it (the
+    # oracle mirrors the gate, keeping the bitwise pin).
+    def _pass_b(_):
+        qp_blocks = qprobs.reshape(n, n_blocks, block).transpose(1, 0, 2)
+
+        def tick_b(carry, xs):
+            rv, ri = carry
+            head_b, off, blk, qp_b = xs
+            valid = off + jnp.arange(block, dtype=jnp.int32) < vocab
+            logits = _block_logits(h, head_b, valid, cd)
+            p = jnp.exp(logits / temp[:, None] - lse_full[:, None])
+            res = jnp.maximum(p - qp_b, 0.0)
+            g = jax.random.gumbel(
+                jax.random.fold_in(key, blk), (n, block), jnp.float32
+            )
+            score = jnp.where(valid[None, :], jnp.log(res) + g, -jnp.inf)
+            sm = jnp.max(score, axis=-1)
+            smi = jnp.argmax(score, axis=-1).astype(jnp.int32) + off
+            upd = sm > rv
+            return (jnp.where(upd, sm, rv), jnp.where(upd, smi, ri)), None
+
+        (_, ri), _ = lax.scan(
+            tick_b, (neg, zero_i),
+            (head_blocks, offsets, blk_ids, qp_blocks),
+            unroll=min(n_blocks, 16),
+        )
+        return ri
+
+    need_b = jnp.any(
+        (top_k == 0) & (jnp.asarray(temperature, jnp.float32) > 0.0)
+    )
+    ri = lax.cond(need_b, _pass_b, lambda _: zero_i, None)
+    repl = jnp.where(top_k > 0, buf_tok, ri).astype(jnp.int32)
+    return gi, p_x, repl

@@ -43,9 +43,20 @@ per-slot KV cache and the request loop is continuous batching.
   through chunked prefill with a pinned greedy bit-match. Plug in via
   ``Server(policy=SchedulingPolicy(...))``; without one the scheduler
   is the FIFO loop unchanged.
+- :mod:`~mpit_tpu.serve.spec` — speculative decoding (ISSUE 13): the
+  exact draft-then-verify math (proposal distribution, longest-
+  accepted-prefix emission with EOS/budget clamps, the full-logits
+  verify oracle). ``Engine(spec_k=k, draft_params=, draft_cfg=)``
+  drafts ``k`` tokens per slot and verifies them in ONE T=k+1 target
+  pass; cache lengths advance by the accepted count only (the
+  rollback). Greedy output bit-matches the plain engine; sampling is
+  exact rejection sampling through the blocked LM head
+  (``ops.lm_head.lm_head_verify``).
 - :mod:`~mpit_tpu.serve.weights` — dense-checkpoint ingestion: a
   ``train.convert --save-dense`` ``.npz`` from ANY training tier serves
-  directly (leaf contract pinned in ``tests/test_convert.py``).
+  directly (leaf contract pinned in ``tests/test_convert.py``);
+  ``draft_from_target`` cuts an early-exit self-speculation draft from
+  the target's own first N blocks.
 
 CLI: ``python -m mpit_tpu.serve`` — load a dense checkpoint (or
 random-init), serve a synthetic request stream, print the obs summary.
@@ -77,6 +88,7 @@ from mpit_tpu.serve.policy import (
 )
 from mpit_tpu.serve.scheduler import Completed, Request, Server, warm_engine
 from mpit_tpu.serve.weights import (
+    draft_from_target,
     expected_param_shapes,
     infer_config,
     load_gpt2_params,
@@ -102,6 +114,7 @@ __all__ = [
     "cache_specs",
     "paged_cache_specs",
     "pages_needed",
+    "draft_from_target",
     "expected_param_shapes",
     "generate_arrivals",
     "infer_config",

@@ -103,6 +103,17 @@ class ServeConfig:
     kv_pages: int = 0
     kv_page_size: int = 16
     prefill_chunk: int = 0
+    # Speculative decoding (ISSUE 13). spec_k > 0 swaps the decode tick
+    # for draft-then-verify (k drafted tokens per slot, one T=k+1 target
+    # verify, longest-prefix acceptance with cache rollback). The draft
+    # comes from --draft-ckpt (a dense .npz, any tier's export) or
+    # --draft-config ("tiny" = random-init tiny config at the target's
+    # vocab; "truncate:N" = the target's own first N blocks — the
+    # self-speculation draft, no second checkpoint needed).
+    spec_k: int = 0
+    draft_ckpt: str = ""
+    draft_config: str = ""
+    draft_num_heads: int = 0  # --draft-ckpt head-count override
     mesh: str = ""  # e.g. "model=2" -> TP engine over that axis
     sentinel: bool = False  # decode/prefill tick anomaly sentinel
     trace: str = ""  # write a Chrome trace of the run here
@@ -158,6 +169,70 @@ def _build_engine(cfg: ServeConfig):
         params = jax.jit(GPT2(mcfg).init)(
             jax.random.key(cfg.seed), jnp.zeros((1, 8), jnp.int32)
         )["params"]
+    # Speculative-decode draft resolution + submit-time validation of
+    # incompatible combinations (ISSUE 13 satellite): every rejection
+    # here is a precise SystemExit BEFORE the first jitted step — never
+    # a shape error (or silent corruption) inside one.
+    draft_params, draft_cfg = None, None
+    if cfg.spec_k:
+        from mpit_tpu.serve import draft_from_target
+
+        if cfg.draft_ckpt and cfg.draft_config:
+            raise SystemExit(
+                "--draft-ckpt and --draft-config are mutually "
+                "exclusive: one draft model per engine"
+            )
+        if cfg.draft_ckpt:
+            draft_params, draft_cfg = load_gpt2_params(
+                cfg.draft_ckpt, num_heads=cfg.draft_num_heads
+            )
+        elif cfg.draft_config.startswith("truncate:"):
+            try:
+                n = int(cfg.draft_config.split(":", 1)[1])
+            except ValueError:
+                raise SystemExit(
+                    f"--draft-config {cfg.draft_config!r}: expected "
+                    "truncate:<num_layers>"
+                )
+            if not 1 <= n < mcfg.num_layers:
+                raise SystemExit(
+                    f"--draft-config truncate:{n}: need 1 <= N < the "
+                    f"target's {mcfg.num_layers} layers (an equal-depth "
+                    "draft costs what the target costs)"
+                )
+            draft_params, draft_cfg = draft_from_target(params, mcfg, n)
+        elif cfg.draft_config == "tiny":
+            draft_cfg = GPT2Config.tiny(
+                vocab_size=mcfg.vocab_size,
+                max_seq_len=mcfg.max_seq_len,
+                dtype=mcfg.dtype,
+            )
+            draft_params = jax.jit(GPT2(draft_cfg).init)(
+                jax.random.key(cfg.seed + 1), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+        else:
+            raise SystemExit(
+                f"--spec-k {cfg.spec_k} needs a draft: --draft-ckpt "
+                f"state.npz, --draft-config tiny, or --draft-config "
+                f"truncate:N (got draft_config={cfg.draft_config!r})"
+            )
+        if not cfg.kv_pages:
+            # The dense verify needs spec_k-1 rows of headroom past
+            # prompt + max_new (dynamic_update_slice clamps, it does
+            # not drop); reject the geometry here, not per request.
+            need = cfg.prompt_len + cfg.max_new_tokens + cfg.spec_k - 1
+            if not cfg.loadgen and need > cfg.max_len:
+                raise SystemExit(
+                    f"--spec-k {cfg.spec_k}: prompt_len + max_new_tokens"
+                    f" + spec_k - 1 = {need} > --max-len {cfg.max_len} "
+                    "on the dense engine; shrink the stream, lower "
+                    "--spec-k, grow --max-len, or use --kv-pages "
+                    "(the paged engine drops out-of-range draft rows)"
+                )
+    elif cfg.draft_ckpt or cfg.draft_config:
+        raise SystemExit(
+            "--draft-ckpt/--draft-config require --spec-k >= 1"
+        )
     engine = Engine(
         mcfg,
         params,
@@ -175,6 +250,9 @@ def _build_engine(cfg: ServeConfig):
         # --kv-pages must surface the Engine's "paged-engine knob"
         # rejection, not silently run whole-prompt prefills.
         prefill_chunk=cfg.prefill_chunk or None,
+        spec_k=cfg.spec_k,
+        draft_params=draft_params,
+        draft_cfg=draft_cfg,
     )
     return engine, mcfg
 
@@ -317,6 +395,17 @@ def main(argv: list[str] | None = None) -> dict:
                 raise SystemExit(
                     f"--loadgen class {klass.name!r}: prefix + prompt_max "
                     f"+ new_max = {need} > --max-len {cfg.max_len}"
+                )
+            if cfg.spec_k and not cfg.kv_pages and (
+                need + cfg.spec_k - 1 > cfg.max_len
+            ):
+                raise SystemExit(
+                    f"--loadgen class {klass.name!r} + --spec-k "
+                    f"{cfg.spec_k}: the dense verify needs spec_k-1 "
+                    f"rows of headroom — prefix + prompt_max + new_max "
+                    f"+ spec_k - 1 = {need + cfg.spec_k - 1} > "
+                    f"--max-len {cfg.max_len}; lower --spec-k, grow "
+                    "--max-len, or use --kv-pages"
                 )
         # Warm the engine's two compiles OUTSIDE the timed window — an
         # open-loop harness that pays multi-second XLA compiles inside

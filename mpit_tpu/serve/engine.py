@@ -38,11 +38,27 @@ fixes the traced prefill width so the scheduler can slice long admits
 across ticks (chunked prefill); still exactly two compiles (+ the tiny
 COW page-copy). Same step count, same calling convention under TP.
 
+Speculative decoding (ISSUE 13): ``Engine(spec_k=k, draft_params=...,
+draft_cfg=...)`` swaps the decode tick for draft-then-verify — a draft
+model (own KV cache; the paged draft pool mirrors the target's page
+geometry and shares its block tables, so COW/prefix-sharing/preemption
+carry draft K/V for free) proposes ``k`` tokens per slot, the target
+scores all ``k+1`` positions in ONE T=k+1 pass through the same
+forward (flash-decode small-T trace included), and cache lengths
+advance by the accepted count only (the rollback). Greedy speculative
+output bit-matches the plain engine per request; temperature/top-k go
+through exact rejection sampling against the blocked LM head
+(``ops.lm_head.lm_head_verify``; the reference engine verifies on
+materialized logits — the oracle). Compile count stays fixed for the
+engine's lifetime: prefill (draft fused), ``spec_draft``,
+``spec_verify`` (+ the COW copy on the paged engine).
+
 Host surface: :meth:`Engine.prefill` (dense) /
 :meth:`Engine.prefill_paged` + :meth:`Engine.copy_page` (paged) /
-:meth:`Engine.decode` — the scheduler (``serve.scheduler``) owns
-queueing, admission (page allocation, COW, prefix registration),
-retirement and observability around them.
+:meth:`Engine.decode`, or :meth:`Engine.spec_draft` +
+:meth:`Engine.spec_verify` on a speculative engine — the scheduler
+(``serve.scheduler``) owns queueing, admission (page allocation, COW,
+prefix registration), retirement and observability around them.
 """
 
 from __future__ import annotations
@@ -70,7 +86,12 @@ from mpit_tpu.ops.decode_attention import (
     num_kv_blocks,
     pick_block_k,
 )
-from mpit_tpu.ops.lm_head import lm_head_sample
+from mpit_tpu.ops.lm_head import lm_head_sample, lm_head_verify
+from mpit_tpu.serve.spec import (
+    accept_emit,
+    draft_distribution,
+    verify_reference,
+)
 from mpit_tpu.serve.kvcache import (
     KVCache,
     PageAllocator,
@@ -269,6 +290,20 @@ def _tp_paged_forward(
     )
 
 
+def _trimmed_sharding(world, spec):
+    """NamedSharding for ``spec`` with trailing Nones dropped. jit keys
+    on the canonical form — the steps' outputs come back as
+    ``P(..., axis)`` while ``cache_specs`` spells ``P(..., axis, None)``
+    — and a construction-vs-output sharding mismatch is one silent
+    recompile on the second admission wave. Deriving from the spec (not
+    a hardcoded literal) keeps cache_specs the single owner of the
+    cache's sharded-axis position."""
+    parts = list(spec)
+    while parts and parts[-1] is None:
+        parts.pop()
+    return world.sharding(*parts)
+
+
 def _tp_param_specs(cfg, params, axis: str):
     """Spec tree mirroring a dense GPT-2 param tree: ``tp_block_specs``
     per block, everything else replicated."""
@@ -314,6 +349,9 @@ class Engine:
         kv_pages: int | None = None,
         kv_page_size: int = 16,
         prefill_chunk: int | None = None,
+        spec_k: int = 0,
+        draft_params=None,
+        draft_cfg: GPT2Config | None = None,
     ):
         if decode_attention not in _DECODE_MODES:
             raise ValueError(
@@ -362,6 +400,50 @@ class Engine:
         self.prefill_chunk = min(
             prefill_chunk or self.prefill_len, self.prefill_len
         )
+
+        # -- speculative decoding (ISSUE 13 tentpole) ------------------------
+        # spec_k > 0 swaps the decode tick for per-slot draft-then-
+        # verify: a draft model (own KV cache — dense per-slot, or a
+        # page pool MIRRORING the target's page geometry so block
+        # tables, COW remaps and prefix sharing carry draft K/V for
+        # free) proposes k tokens per slot, the target scores all k+1
+        # positions in ONE T=k+1 pass through the existing forward
+        # (flash-decode small-T trace included), and cache lengths
+        # advance by the accepted count only — rejected drafts' rows
+        # become junk past the watermark, which the mask hides and the
+        # next append overwrites (the rollback). Still a fixed compile
+        # count for the engine's lifetime: prefill (draft fused),
+        # spec_draft, spec_verify (+ copy_page on the paged engine).
+        self.spec_k = int(spec_k or 0)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if self.spec_k:
+            if draft_params is None or draft_cfg is None:
+                raise ValueError(
+                    "spec_k > 0 requires draft_params and draft_cfg "
+                    "(the draft model proposing the k tokens the "
+                    "target verifies) — load one via serve.weights."
+                    "load_gpt2_params or truncate the target with "
+                    "serve.weights.draft_from_target"
+                )
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size {draft_cfg.vocab_size} != target "
+                    f"vocab_size {cfg.vocab_size}: speculation verifies "
+                    "draft proposals under the target distribution — "
+                    "the vocabularies must be identical"
+                )
+            if draft_cfg.max_seq_len < self.max_len:
+                raise ValueError(
+                    f"draft max_seq_len {draft_cfg.max_seq_len} < engine "
+                    f"max_len {self.max_len}: the draft's positional "
+                    "table must cover every cache position it drafts at"
+                )
+        elif draft_params is not None or draft_cfg is not None:
+            raise ValueError(
+                "draft_params/draft_cfg without spec_k: pass "
+                "spec_k >= 1 to enable speculation"
+            )
 
         # -- serving hot-loop shape (ISSUE 5): attention kernel + head --
         self.decode_attention = decode_attention
@@ -463,7 +545,7 @@ class Engine:
             )
             if self.paged:
                 cs = paged_cache_specs(tp_axis)
-                sharding = world.sharding(*cs.k)
+                sharding = _trimmed_sharding(world, cs.k)
                 rep = jax.sharding.PartitionSpec()
                 fwd = world.shard_map(
                     functools.partial(
@@ -475,7 +557,7 @@ class Engine:
                 )
             else:
                 cs = cache_specs(tp_axis)
-                sharding = world.sharding(*cs.k)
+                sharding = _trimmed_sharding(world, cs.k)
                 fwd = world.shard_map(
                     functools.partial(
                         _tp_cache_forward, cfg=cfg, axis=tp_axis,
@@ -513,6 +595,46 @@ class Engine:
                 return out, KVCache(k=k2, v=v2, lengths=cache.lengths)
 
         self.params = params
+        # Draft model + its cache (ISSUE 13). The draft always runs the
+        # reference attention and materializes its (tiny) logits — the
+        # proposal distribution q is part of the acceptance contract.
+        # The draft stays REPLICATED under TP (its per-tick cost is the
+        # speculation overhead; sharding a 2-layer draft buys nothing).
+        self.draft_cfg = draft_cfg
+        self._spec_state = None  # device-side (drafted, q_x, q_probs)
+        if self.spec_k:
+            self._draft_model = GPT2(draft_cfg)
+            drep = None
+            if tp_axis is not None:
+                # Pin the draft replicated across the mesh AT
+                # CONSTRUCTION — otherwise the first mesh step re-lays
+                # the arrays out and the second call recompiles,
+                # breaking the engine's pinned lifetime compile count.
+                drep = world.sharding()
+                draft_params = jax.device_put(
+                    draft_params,
+                    jax.tree.map(lambda _: drep, draft_params),
+                )
+            if self.paged:
+                self.draft_cache = alloc_paged_cache(
+                    draft_cfg, slots, self.num_pages, self.page_size,
+                    sharding=drep,
+                )
+            else:
+                self.draft_cache = alloc_cache(
+                    draft_cfg, slots, self.max_len, sharding=drep
+                )
+            if drep is not None:
+                # lengths too — alloc_* shards only K/V, but a later
+                # tick hands back mesh-replicated lengths, and a
+                # sharding change on ANY prefill operand is a recompile.
+                self.draft_cache = jax.device_put(
+                    self.draft_cache,
+                    jax.tree.map(lambda _: drep, self.draft_cache),
+                )
+        else:
+            self.draft_cache = None
+        self.draft_params = draft_params
         if self.paged:
             # Host-side page bookkeeping: free list, refcounts, prefix
             # index, COW reservations, per-slot block tables (the tables
@@ -525,7 +647,11 @@ class Engine:
                 sharding=sharding,
             )
             self._prefill_paged_jit = jax.jit(self._paged_prefill_step)
-            self._decode_paged_jit = jax.jit(self._paged_decode_step)
+            if self.spec_k:
+                self._spec_draft_jit = jax.jit(self._spec_draft_step)
+                self._spec_verify_jit = jax.jit(self._spec_verify_step)
+            else:
+                self._decode_paged_jit = jax.jit(self._paged_decode_step)
             self._copy_page_jit = jax.jit(self._copy_page_step)
         else:
             self.allocator = None
@@ -533,8 +659,26 @@ class Engine:
                 cfg, slots, self.max_len, sharding=sharding
             )
             self._prefill_jit = jax.jit(self._prefill_step)
-            self._decode_jit = jax.jit(self._decode_step)
+            if self.spec_k:
+                self._spec_draft_jit = jax.jit(self._spec_draft_step)
+                self._spec_verify_jit = jax.jit(self._spec_verify_step)
+            else:
+                self._decode_jit = jax.jit(self._decode_step)
         self.last_token = jnp.zeros((slots,), jnp.int32)
+        if tp_axis is not None:
+            # Pin the slot-width control state (lengths, last token)
+            # mesh-replicated at construction. The steps return them
+            # replicated; leaving the INITIAL arrays single-device made
+            # the second admission wave's prefill see a different
+            # operand sharding — one silent extra compile per TP
+            # engine, caught by the CompileWatch pin.
+            rep = world.sharding()
+            self.cache = type(self.cache)(
+                k=self.cache.k,
+                v=self.cache.v,
+                lengths=jax.device_put(self.cache.lengths, rep),
+            )
+            self.last_token = jax.device_put(self.last_token, rep)
         self._forward = fwd
         # Engine-lifetime compile accounting (ISSUE 8): the "two
         # compiles (dense) / three (paged: + copy_page), zero
@@ -542,8 +686,13 @@ class Engine:
         # Every jitted-step invocation below routes through the watch;
         # growth past `expected` is an unexpected recompile (instant +
         # sentinel note — the Server attaches its sentinel).
+        # Speculation keeps the discipline with ONE extra compile: the
+        # decode tick splits into spec_draft + spec_verify (the plain
+        # decode step is never built).
         self.compile_watch = _roofline.CompileWatch(
-            expected=3 if self.paged else 2, scope="engine"
+            expected=(3 if self.paged else 2)
+            + (1 if self.spec_k else 0),
+            scope="engine",
         )
         # Per-execution modeled costs (set by register_roofline).
         self.roofline_costs: dict | None = None
@@ -581,12 +730,48 @@ class Engine:
             compute_dtype=self.cfg.head_dtype,
         )
 
+    # -- draft forwards (ISSUE 13) ------------------------------------------
+    def _draft_forward(self, dparams, tokens, dcache: KVCache, *, with_head):
+        """The draft model's dense cache-aware forward — reference
+        attention, materialized logits (the draft is small by
+        construction; its whole cost is the speculation overhead the
+        acceptance rate must beat). ``with_head=False`` (prefill) stops
+        at ln_f: the draft never samples at prefill."""
+        out, (k2, v2) = self._draft_model.apply(
+            {"params": dparams},
+            tokens,
+            cache=(dcache.k, dcache.v, dcache.lengths),
+            return_hidden=not with_head,
+        )
+        return out, KVCache(k=k2, v=v2, lengths=dcache.lengths)
+
+    def _draft_forward_paged(
+        self, dparams, tokens, dcache: PagedKVCache, block_tables,
+        write_valid, *, with_head,
+    ):
+        """Paged draft forward: the draft pool mirrors the target's
+        page geometry and indirects through the SAME block tables, so
+        prefix sharing, COW remaps and preemption free/remap draft K/V
+        together with the target's."""
+        out, (k2, v2) = self._draft_model.apply(
+            {"params": dparams},
+            tokens,
+            paged_cache=(dcache.k, dcache.v, dcache.lengths,
+                         block_tables, write_valid),
+            return_hidden=not with_head,
+        )
+        return out, PagedKVCache(k=k2, v=v2, lengths=dcache.lengths)
+
     def _prefill_step(
-        self, params, cache, last, tokens, prompt_lens, admit, key, temp, topk
+        self, params, cache, last, tokens, prompt_lens, admit, key, temp,
+        topk, dparams=None, dcache=None,
     ):
         """Whole-slot-batch prefill: every slot computes on the padded
         [slots, prefill_len] buffer from position 0; only admitted
-        slots' cache writes / length resets / first tokens stick."""
+        slots' cache writes / length resets / first tokens stick.
+        Speculative engines fuse the DRAFT prefill into the same step
+        (same tokens, the draft's own cache, no sampling) — the draft
+        cache fill mirrors the target's from the first tick."""
         fresh = KVCache(
             k=cache.k, v=cache.v, lengths=jnp.zeros_like(cache.lengths)
         )
@@ -595,13 +780,24 @@ class Engine:
             params, out, jnp.maximum(prompt_lens - 1, 0), key, temp, topk
         )
         sel = admit[None, :, None, None, None]
-        return (
-            KVCache(
-                k=jnp.where(sel, new.k, cache.k),
-                v=jnp.where(sel, new.v, cache.v),
-                lengths=jnp.where(admit, prompt_lens, cache.lengths),
-            ),
-            jnp.where(admit, tok, last),
+        new_cache = KVCache(
+            k=jnp.where(sel, new.k, cache.k),
+            v=jnp.where(sel, new.v, cache.v),
+            lengths=jnp.where(admit, prompt_lens, cache.lengths),
+        )
+        new_last = jnp.where(admit, tok, last)
+        if not self.spec_k:
+            return new_cache, new_last
+        dfresh = KVCache(
+            k=dcache.k, v=dcache.v, lengths=jnp.zeros_like(dcache.lengths)
+        )
+        _, dnew = self._draft_forward(
+            dparams, tokens, dfresh, with_head=False
+        )
+        return new_cache, new_last, KVCache(
+            k=jnp.where(sel, dnew.k, dcache.k),
+            v=jnp.where(sel, dnew.v, dcache.v),
+            lengths=new_cache.lengths,
         )
 
     def _decode_step(self, params, cache, last, active, key, temp, topk):
@@ -634,6 +830,7 @@ class Engine:
     def _paged_prefill_step(
         self, params, cache, last, tokens, base, chunk_lens, floor,
         sample_mask, block_tables, key, temp, topk,
+        dparams=None, dcache=None,
     ):
         """One prefill CHUNK over the whole slot batch: slot ``s`` feeds
         ``tokens[s, :chunk_lens[s]]`` = its prompt slice starting at
@@ -663,15 +860,29 @@ class Engine:
         tok = self._sample_last(
             params, out, jnp.maximum(chunk_lens - 1, 0), key, temp, topk
         )
-        return (
-            PagedKVCache(
-                k=new.k,
-                v=new.v,
-                lengths=jnp.where(
-                    participates, base + chunk_lens, cache.lengths
-                ),
+        new_cache = PagedKVCache(
+            k=new.k,
+            v=new.v,
+            lengths=jnp.where(
+                participates, base + chunk_lens, cache.lengths
             ),
-            jnp.where(sample_mask, tok, last),
+        )
+        new_last = jnp.where(sample_mask, tok, last)
+        if not self.spec_k:
+            return new_cache, new_last
+        # Draft prefill rides the same chunk: same slices, same write
+        # mask (floor included — shared pages already hold draft K/V
+        # from the slot that registered the prefix), the draft pool's
+        # scatter through the same block tables.
+        dwork = PagedKVCache(
+            k=dcache.k, v=dcache.v, lengths=work.lengths
+        )
+        _, dnew = self._draft_forward_paged(
+            dparams, tokens, dwork, block_tables, write_valid,
+            with_head=False,
+        )
+        return new_cache, new_last, PagedKVCache(
+            k=dnew.k, v=dnew.v, lengths=new_cache.lengths
         )
 
     def _paged_decode_step(
@@ -697,10 +908,188 @@ class Engine:
             jnp.where(active, tok, last),
         )
 
-    def _copy_page_step(self, cache, src, dst):
+    # -- speculative tick bodies (ISSUE 13) ---------------------------------
+    def _spec_draft_step(
+        self, dparams, dcache, last, active, key, temp, topk,
+        block_tables=None, write_cap=None,
+    ):
+        """Phase 1 of the speculative tick: k unrolled T=1 draft-model
+        steps from each active slot's last token through the draft's
+        own cache (k is static — one compile for the engine's
+        lifetime). Draft proposals are exact samples from q — the
+        request's temperature/top-k applied to the draft logits
+        (:func:`~mpit_tpu.serve.spec.draft_distribution`); greedy rows
+        take the draft argmax. Returns the updated draft cache (K/V
+        written at rows ``lengths..lengths+k-1``; LENGTHS UNCHANGED —
+        they advance with the target's at verify, which is also the
+        draft-side rollback) plus the proposals and their
+        q-probabilities for :meth:`_spec_verify_step`."""
+        k = self.spec_k
+        lens0 = jnp.where(active, dcache.lengths, 0)
+        cur = last
+        dk, dv = dcache.k, dcache.v
+        drafted, qx, qprobs = [], [], []
+        for j in range(k):
+            lens_j = lens0 + j
+            if self.paged:
+                # Rows past the slot's mapped pages are DROPPED (the
+                # block table has no entry to scatter them through) and
+                # inactive slots' stale tables are never followed.
+                wv = active[:, None] & (
+                    lens_j[:, None] < write_cap[:, None]
+                )
+                work = PagedKVCache(k=dk, v=dv, lengths=lens_j)
+                out, new = self._draft_forward_paged(
+                    dparams, cur[:, None], work, block_tables, wv,
+                    with_head=True,
+                )
+            else:
+                work = KVCache(k=dk, v=dv, lengths=lens_j)
+                out, new = self._draft_forward(
+                    dparams, cur[:, None], work, with_head=True
+                )
+            dk, dv = new.k, new.v
+            logits = out[:, 0].astype(jnp.float32)
+            probs, scaled = draft_distribution(logits, temp, topk)
+            samp = jax.random.categorical(
+                jax.random.fold_in(key, j), scaled, axis=-1
+            ).astype(jnp.int32)
+            tok = jnp.where(
+                temp <= 0.0,
+                jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                samp,
+            )
+            drafted.append(tok)
+            qx.append(
+                jnp.take_along_axis(probs, tok[:, None], axis=1)[:, 0]
+            )
+            qprobs.append(probs)
+            cur = tok
+        # One head-less append of the LAST drafted token's K/V at row
+        # lengths+k: a fully-accepted tick advances lengths to
+        # lengths+k+1, and without this row the draft's context keeps a
+        # permanent garbage position INSIDE its attended window — output
+        # exactness survives (verify corrects everything) but acceptance
+        # collapses in exactly the high-acceptance regime speculation
+        # exists for (a bit-identical draft measured 0.52, not 1.0).
+        # On a rejected tick the row sits past the watermark, masked,
+        # like every other rejected draft row.
+        lens_k = lens0 + k
+        if self.paged:
+            wv = active[:, None] & (lens_k[:, None] < write_cap[:, None])
+            work = PagedKVCache(k=dk, v=dv, lengths=lens_k)
+            _, new = self._draft_forward_paged(
+                dparams, cur[:, None], work, block_tables, wv,
+                with_head=False,
+            )
+        else:
+            work = KVCache(k=dk, v=dv, lengths=lens_k)
+            _, new = self._draft_forward(
+                dparams, cur[:, None], work, with_head=False
+            )
+        cls = PagedKVCache if self.paged else KVCache
+        return (
+            cls(k=new.k, v=new.v, lengths=dcache.lengths),
+            jnp.stack(drafted, axis=1),  # [S, k] int32
+            jnp.stack(qx, axis=1),       # [S, k] f32
+            jnp.stack(qprobs, axis=1),   # [S, k, V] f32
+        )
+
+    def _spec_verify_step(
+        self, params, cache, last, active, drafted, qx, qprobs, key,
+        temp, topk, budget, eos, block_tables=None, write_cap=None,
+    ):
+        """Phase 2: ONE T=k+1 target pass over ``[last, d_1..d_k]``
+        (the flash-decode kernel's small-T trace — k+1 query rows, the
+        same length-aware tile loop), verify sampling over all k+1
+        positions (blocked :func:`~mpit_tpu.ops.lm_head.lm_head_verify`
+        or the full-logits reference — whatever the engine's sampler
+        is), then longest-accepted-prefix emission. Cache lengths
+        advance by the accepted count ONLY: rejected drafts' K/V rows
+        sit past the new watermark, masked, overwritten by the next
+        append — the rollback, dense and paged alike."""
+        k = self.spec_k
+        lens = jnp.where(active, cache.lengths, 0)
+        feed = jnp.concatenate([last[:, None], drafted], axis=1)
+        if self.paged:
+            pos = lens[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None]
+            wv = active[:, None] & (pos < write_cap[:, None])
+            work = PagedKVCache(k=cache.k, v=cache.v, lengths=lens)
+            out, new = self._forward(params, feed, work, block_tables, wv)
+        else:
+            work = KVCache(k=cache.k, v=cache.v, lengths=lens)
+            out, new = self._forward(params, feed, work)
+        s = out.shape[0]
+        nrows = s * (k + 1)
+        vkey, ukey = jax.random.split(key)
+        # Bonus position: q = 0 makes its residual a plain target
+        # sample (max(p - 0, 0) = p) — one formula for reject + bonus.
+        qpad = jnp.concatenate(
+            [qprobs, jnp.zeros_like(qprobs[:, :1])], axis=1
+        )
+        drafted_pad = jnp.pad(drafted, ((0, 0), (0, 1)))
+        temp_rows = jnp.repeat(temp, k + 1)
+        topk_rows = jnp.repeat(topk, k + 1)
+        if self._blocked_head:
+            head = params["head"] if "head" in params else params["wte"]
+            g, p_x, repl = lm_head_verify(
+                out.reshape(nrows, out.shape[-1]),
+                head,
+                drafted_pad.reshape(nrows),
+                qpad.reshape(nrows, -1),
+                vkey, temp_rows, topk_rows,
+                block_size=self._sample_block,
+                k_cap=self.sample_k_cap,
+                compute_dtype=self.cfg.head_dtype,
+            )
+        else:
+            # Reference engine: materialized logits + the full-logits
+            # verifier — the parity oracle. k_cap = vocab keeps the
+            # reference's top-k semantics unbounded, like its sampler.
+            g, p_x, repl = verify_reference(
+                out.reshape(nrows, out.shape[-1]).astype(jnp.float32),
+                drafted_pad.reshape(nrows),
+                qpad.reshape(nrows, -1),
+                vkey, temp_rows, topk_rows,
+                k_cap=self.cfg.vocab_size,
+                block_size=self._sample_block,
+            )
+        g = g.reshape(s, k + 1)
+        p_x = p_x.reshape(s, k + 1)
+        repl = repl.reshape(s, k + 1)
+        u = jax.random.uniform(ukey, (s, k), jnp.float32)
+        emit, n_emit, n_acc = accept_emit(
+            drafted, g, p_x[:, :k], qx, u, repl,
+            temp <= 0.0, budget, eos,
+        )
+        n_emit = jnp.where(active, n_emit, 0)
+        n_acc = jnp.where(active, n_acc, 0)
+        new_last = jnp.where(
+            active,
+            jnp.take_along_axis(
+                emit, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+            )[:, 0],
+            last,
+        )
+        if self.paged:
+            out_cache = PagedKVCache(
+                k=new.k, v=new.v, lengths=lens + n_emit
+            )
+        else:
+            sel = active[None, :, None, None, None]
+            out_cache = KVCache(
+                k=jnp.where(sel, new.k, cache.k),
+                v=jnp.where(sel, new.v, cache.v),
+                lengths=lens + n_emit,
+            )
+        return out_cache, new_last, emit, n_emit, n_acc
+
+    def _copy_page_step(self, cache, src, dst, dcache=None):
         """Copy pool page ``src`` → ``dst`` across every layer, K and V
         — the device half of a copy-on-write remap (the allocator
-        already repointed the block table at ``dst``)."""
+        already repointed the block table at ``dst``). A speculative
+        engine's draft pool shares the block tables, so the same remap
+        copies its page too."""
 
         def cp(pool):
             page = jax.lax.dynamic_index_in_dim(
@@ -710,8 +1099,13 @@ class Engine:
                 pool, page, dst, axis=1
             )
 
-        return PagedKVCache(
+        out = PagedKVCache(
             k=cp(cache.k), v=cp(cache.v), lengths=cache.lengths
+        )
+        if not self.spec_k:
+            return out
+        return out, PagedKVCache(
+            k=cp(dcache.k), v=cp(dcache.v), lengths=dcache.lengths
         )
 
     # -- host surface (the scheduler's API) ---------------------------------
@@ -729,9 +1123,7 @@ class Engine:
                 "the paged engine prefills through prefill_paged (block-"
                 "table writes + chunking); the dense prefill has no pages"
             )
-        self.cache, self.last_token = self.compile_watch.call(
-            "prefill",
-            self._prefill_jit,
+        args = [
             self.params,
             self.cache,
             self.last_token,
@@ -741,7 +1133,16 @@ class Engine:
             self._split(),
             jnp.asarray(temp, jnp.float32),
             jnp.asarray(topk, jnp.int32),
-        )
+        ]
+        if self.spec_k:
+            args += [self.draft_params, self.draft_cache]
+            self.cache, self.last_token, self.draft_cache = (
+                self.compile_watch.call("prefill", self._prefill_jit, *args)
+            )
+        else:
+            self.cache, self.last_token = self.compile_watch.call(
+                "prefill", self._prefill_jit, *args
+            )
         return np.asarray(self.last_token)
 
     def prefill_paged(
@@ -756,9 +1157,7 @@ class Engine:
         ``sample_mask`` is set) as host numpy."""
         if not self.paged:
             raise ValueError("prefill_paged requires Engine(kv_pages=...)")
-        self.cache, self.last_token = self.compile_watch.call(
-            "prefill",
-            self._prefill_paged_jit,
+        args = [
             self.params,
             self.cache,
             self.last_token,
@@ -771,24 +1170,119 @@ class Engine:
             self._split(),
             jnp.asarray(temp, jnp.float32),
             jnp.asarray(topk, jnp.int32),
-        )
+        ]
+        if self.spec_k:
+            args += [self.draft_params, self.draft_cache]
+            self.cache, self.last_token, self.draft_cache = (
+                self.compile_watch.call(
+                    "prefill", self._prefill_paged_jit, *args
+                )
+            )
+        else:
+            self.cache, self.last_token = self.compile_watch.call(
+                "prefill", self._prefill_paged_jit, *args
+            )
         return np.asarray(self.last_token)
 
     def copy_page(self, src: int, dst: int) -> None:
         """Device half of a COW remap: copy pool page ``src`` → ``dst``
-        (all layers, K and V). Page ids ride as traced scalars — one
-        compile serves every copy."""
-        self.cache = self.compile_watch.call(
-            "copy_page",
-            self._copy_page_jit,
+        (all layers, K and V; the draft pool too on a speculative
+        engine — same block tables, same remap). Page ids ride as
+        traced scalars — one compile serves every copy."""
+        args = [
             self.cache,
             jnp.asarray(src, jnp.int32),
             jnp.asarray(dst, jnp.int32),
+        ]
+        if self.spec_k:
+            self.cache, self.draft_cache = self.compile_watch.call(
+                "copy_page", self._copy_page_jit, *args, self.draft_cache
+            )
+        else:
+            self.cache = self.compile_watch.call(
+                "copy_page", self._copy_page_jit, *args
+            )
+
+    def spec_draft(self, active, temp, topk) -> None:
+        """Phase 1 of a speculative tick: draft ``spec_k`` tokens per
+        active slot (``_spec_draft_step``). Proposals and their
+        q-probabilities stay DEVICE-side for :meth:`spec_verify`; the
+        fence (``block_until_ready``) makes the caller's span wall
+        clock cover real draft completion."""
+        if not self.spec_k:
+            raise ValueError("spec_draft requires Engine(spec_k=...)")
+        args = [
+            self.draft_params,
+            self.draft_cache,
+            self.last_token,
+            jnp.asarray(active, bool),
+            self._split(),
+            jnp.asarray(temp, jnp.float32),
+            jnp.asarray(topk, jnp.int32),
+        ]
+        if self.paged:
+            args += [
+                jnp.asarray(self.allocator.block_tables, jnp.int32),
+                jnp.asarray(self.allocator.mapped_tokens(), jnp.int32),
+            ]
+        self.draft_cache, drafted, qx, qprobs = self.compile_watch.call(
+            "spec_draft", self._spec_draft_jit, *args
         )
+        jax.block_until_ready(drafted)
+        self._spec_state = (drafted, qx, qprobs)
+
+    def spec_verify(self, active, temp, topk, budget, eos):
+        """Phase 2: one T=k+1 target pass + verify sampling + rollback
+        (``_spec_verify_step``) over the pending :meth:`spec_draft`
+        proposals. ``budget`` [slots] int32 = tokens each request may
+        still emit; ``eos`` [slots] int32 per-request EOS id (-1 =
+        none). Returns host numpy ``(emit [S, k+1], n_emit [S], n_acc
+        [S])`` — slot ``s`` emitted ``emit[s, :n_emit[s]]`` this tick
+        (the fetch is the step's completion fence)."""
+        if self._spec_state is None:
+            raise ValueError("spec_verify without a pending spec_draft")
+        drafted, qx, qprobs = self._spec_state
+        self._spec_state = None
+        args = [
+            self.params,
+            self.cache,
+            self.last_token,
+            jnp.asarray(active, bool),
+            drafted,
+            qx,
+            qprobs,
+            self._split(),
+            jnp.asarray(temp, jnp.float32),
+            jnp.asarray(topk, jnp.int32),
+            jnp.asarray(budget, jnp.int32),
+            jnp.asarray(eos, jnp.int32),
+        ]
+        if self.paged:
+            args += [
+                jnp.asarray(self.allocator.block_tables, jnp.int32),
+                jnp.asarray(self.allocator.mapped_tokens(), jnp.int32),
+            ]
+        self.cache, self.last_token, emit, n_emit, n_acc = (
+            self.compile_watch.call(
+                "spec_verify", self._spec_verify_jit, *args
+            )
+        )
+        # The draft cache's fill mirrors the target's — ONE lengths
+        # assignment applies the acceptance rollback to both.
+        dc = self.draft_cache
+        self.draft_cache = type(dc)(
+            k=dc.k, v=dc.v, lengths=self.cache.lengths
+        )
+        return np.asarray(emit), np.asarray(n_emit), np.asarray(n_acc)
 
     def decode(self, active, temp, topk) -> np.ndarray:
         """One decode tick over the slot batch; returns the per-slot
         next token (host numpy; stale for inactive slots)."""
+        if self.spec_k:
+            raise ValueError(
+                "a speculative engine ticks through spec_draft + "
+                "spec_verify (there is no plain decode step to run)"
+            )
         if self.paged:
             self.cache, self.last_token = self.compile_watch.call(
                 "decode",
@@ -837,6 +1331,9 @@ class Engine:
         f32 = jnp.zeros((s,), jnp.float32)
         i32 = jnp.zeros((s,), jnp.int32)
         msk = jnp.zeros((s,), bool)
+        spec_tail = (
+            [self.draft_params, self.draft_cache] if self.spec_k else []
+        )
         if self.paged:
             toks = jnp.zeros((s, self.prefill_chunk), jnp.int32)
             bt = jnp.zeros((s, self.pages_per_slot), jnp.int32)
@@ -844,28 +1341,61 @@ class Engine:
                 "prefill": (
                     self._prefill_paged_jit,
                     (self.params, self.cache, self.last_token, toks, i32,
-                     i32, i32, msk, bt, key, f32, i32),
+                     i32, i32, msk, bt, key, f32, i32, *spec_tail),
                 ),
-                "decode": (
+            }
+            if self.spec_k:
+                k = self.spec_k
+                steps["spec_draft"] = (
+                    self._spec_draft_jit,
+                    (self.draft_params, self.draft_cache,
+                     self.last_token, msk, key, f32, i32, bt, i32),
+                )
+                steps["spec_verify"] = (
+                    self._spec_verify_jit,
+                    (self.params, self.cache, self.last_token, msk,
+                     jnp.zeros((s, k), jnp.int32),
+                     jnp.zeros((s, k), jnp.float32),
+                     jnp.zeros((s, k, self.cfg.vocab_size), jnp.float32),
+                     key, f32, i32, i32, i32, bt, i32),
+                )
+            else:
+                steps["decode"] = (
                     self._decode_paged_jit,
                     (self.params, self.cache, self.last_token, msk, bt,
                      key, f32, i32),
-                ),
-            }
+                )
         else:
             toks = jnp.zeros((s, self.prefill_len), jnp.int32)
             steps = {
                 "prefill": (
                     self._prefill_jit,
                     (self.params, self.cache, self.last_token, toks,
-                     jnp.ones((s,), jnp.int32), msk, key, f32, i32),
+                     jnp.ones((s,), jnp.int32), msk, key, f32, i32,
+                     *spec_tail),
                 ),
-                "decode": (
+            }
+            if self.spec_k:
+                k = self.spec_k
+                steps["spec_draft"] = (
+                    self._spec_draft_jit,
+                    (self.draft_params, self.draft_cache,
+                     self.last_token, msk, key, f32, i32),
+                )
+                steps["spec_verify"] = (
+                    self._spec_verify_jit,
+                    (self.params, self.cache, self.last_token, msk,
+                     jnp.zeros((s, k), jnp.int32),
+                     jnp.zeros((s, k), jnp.float32),
+                     jnp.zeros((s, k, self.cfg.vocab_size), jnp.float32),
+                     key, f32, i32, i32, i32),
+                )
+            else:
+                steps["decode"] = (
                     self._decode_jit,
                     (self.params, self.cache, self.last_token, msk, key,
                      f32, i32),
-                ),
-            }
+                )
         out = {}
         for phase, (fn, args) in steps.items():
             try:
@@ -883,21 +1413,25 @@ class Engine:
         self.roofline_costs = out
         return out
 
-    def decode_achieved_hbm_bytes(self, live_lens) -> float | None:
+    def decode_achieved_hbm_bytes(self, live_lens, t_q: int = 1):
         """Length-aware modeled HBM bytes for ONE decode tick:
         ``live_lens`` are the live slots' cache fills (host mirror) at
         tick start. Visited K/V tiles come from the host formula
         :func:`~mpit_tpu.ops.decode_attention.num_kv_blocks` — pinned
         bitwise against the kernel's own in-kernel visited count — plus
         one tile per clamped free slot, the param read, and the
-        appended rows. ``None`` on the dense reference engine (no
-        tiling claim to account); on the off-TPU kernel fallback the
-        figure is the MODEL of the kernel path (the platform label on
-        the registered cost marks it modeled)."""
+        appended rows. ``t_q`` is the tick's query width (1 plain;
+        ``spec_k + 1`` for a speculative verify — its tile bound is
+        ``ceil((L + k + 1)/block_k)``). ``None`` on the dense reference
+        engine (no tiling claim to account); on the off-TPU kernel
+        fallback the figure is the MODEL of the kernel path (the
+        platform label on the registered cost marks it modeled)."""
         if self.decode_attention == "reference":
             return None
         lens = np.asarray(live_lens)
-        visited = num_kv_blocks(lens, 1, self.max_len, self.decode_block_k)
+        visited = num_kv_blocks(
+            lens, t_q, self.max_len, self.decode_block_k
+        )
         total_tiles = int(visited.sum()) + (self.slots - lens.size)
         return _roofline.decode_step_hbm_bytes(
             total_tiles,
@@ -905,7 +1439,7 @@ class Engine:
             kv_row_bytes=self._kv_row_bytes,
             num_layers=self.cfg.num_layers,
             param_bytes=self._param_bytes,
-            appended_rows=lens.size,
+            appended_rows=lens.size * t_q,
         )
 
     def lengths(self) -> np.ndarray:
@@ -921,5 +1455,12 @@ class Engine:
         )
         self.last_token = jnp.zeros_like(self.last_token)
         self._key = jax.random.key(seed)
+        self._spec_state = None
+        if self.draft_cache is not None:
+            self.draft_cache = type(self.draft_cache)(
+                k=jnp.zeros_like(self.draft_cache.k),
+                v=jnp.zeros_like(self.draft_cache.v),
+                lengths=jnp.zeros_like(self.draft_cache.lengths),
+            )
         if self.paged:
             self.allocator.reset()

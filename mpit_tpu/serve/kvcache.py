@@ -422,6 +422,19 @@ class PageAllocator:
             for p in pages:
                 self._page_keys.setdefault(p, set()).add(key)
 
+    def mapped_tokens(self) -> np.ndarray:
+        """Per-slot writable capacity (mapped pages × page_size) as an
+        int32 [slots] array — the speculative steps' write cap (ISSUE
+        13): junk rows inside OWNED pages are mask-hidden, but a row
+        past the mapping would scatter through a zeroed table entry
+        into page 0, which another slot may own — those writes must be
+        DROPPED, and this array is where the in-step mask learns the
+        boundary."""
+        out = np.zeros((self.slots,), np.int32)
+        for slot, pages in self._slot_pages.items():
+            out[slot] = len(pages) * self.page_size
+        return out
+
     # -- write path ---------------------------------------------------------
     def cow_before_write(self, slot: int, position: int):
         """Make the page holding ``position`` privately writable by

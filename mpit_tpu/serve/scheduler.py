@@ -94,6 +94,20 @@ windows this server feeds once per tick; per-tier TTFT series
 (``request_ttft_tenant:<t>``) land in the registry so SLOs and the
 ``stats()`` tenant roll-up can tell the classes apart. Without a
 policy every path below is byte-for-byte the FIFO scheduler.
+
+ISSUE 13 (speculative decoding): on an ``Engine(spec_k=k, ...)`` the
+decode tick becomes :meth:`Server._spec_tick` — draft ``k`` tokens per
+live slot, verify all ``k+1`` positions in one target pass, append each
+slot's emitted prefix and retire exactly as the plain tick would (EOS /
+token budget are clamped IN-STEP, so device lengths and the host token
+lists never diverge). The tick is spanned ``decode`` with nested
+``spec_draft`` / ``spec_verify`` spans (the ``attention=`` idiom on all
+three); ``accepted_tokens_per_tick`` (emitted per slot-tick, 1.0 =
+plain decode) and ``draft_acceptance_rate`` feed the rolling windows
+and ``stats()`` — the ``gpt2_serve`` record line carries the former.
+Submit validation grows the dense-engine headroom check (the verify
+writes ``k+1`` rows at the fill; ``prompt + max_new + k - 1`` must fit
+``max_len`` — the paged engine instead DROPS out-of-range rows).
 """
 
 from __future__ import annotations
@@ -113,8 +127,9 @@ __all__ = ["Request", "Completed", "Server", "warm_engine"]
 
 
 def warm_engine(engine, *, register_costs: bool = False) -> None:
-    """Pay the engine's two XLA compiles (prefill + decode) with one
-    throwaway request, then reset the cache — call BEFORE any timed
+    """Pay the engine's lifetime XLA compiles (prefill + decode — or
+    prefill + spec_draft + spec_verify on a speculative engine) with
+    one throwaway request, then reset the cache — call BEFORE any timed
     window so an open-loop harness's first arrivals measure the server,
     not the compiler. Prompt content is irrelevant: the padded
     prefill/decode buffers fix the traced shapes.
@@ -287,6 +302,15 @@ class Server:
         )
         self._sampler = getattr(engine, "decode_sampler", "dense")
         self._paged = bool(getattr(engine, "paged", False))
+        # Speculative decoding (ISSUE 13): spec_k > 0 swaps the decode
+        # tick for draft-then-verify; the accumulators feed stats()'s
+        # accepted_tokens_per_tick / draft_acceptance_rate (what the
+        # gpt2_serve record line carries).
+        self._spec = int(getattr(engine, "spec_k", 0) or 0)
+        self._spec_emitted = 0
+        self._spec_active_ticks = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         # Compile + utilization sentinel rules (ISSUE 8): an unexpected
         # engine recompile and a sustained collapse of the decode HBM
         # rate both land in THIS server's sentinel report, next to the
@@ -364,6 +388,24 @@ class Server:
                 f"({len(req.prompt)} + {req.max_new_tokens}) exceeds the "
                 f"engine's max_len {self.engine.max_len}"
             )
+        if self._spec and not self._paged:
+            # The dense verify writes k+1 rows at the current fill via
+            # dynamic_update_slice, whose start CLAMPS at the buffer
+            # edge — without headroom the window would shift backwards
+            # and silently corrupt earlier rows inside the jitted step.
+            # (The paged engine needs none: rows past a slot's mapped
+            # pages are scatter-DROPPED.) Raise the precise error here,
+            # at submit (ISSUE 13 satellite).
+            need = len(req.prompt) + req.max_new_tokens + self._spec - 1
+            if need > self.engine.max_len:
+                raise ValueError(
+                    f"request {req.rid!r}: speculative decode (spec_k="
+                    f"{self._spec}) writes draft rows past the fill — "
+                    f"prompt + max_new_tokens + spec_k - 1 = {need} "
+                    f"exceeds the dense cache's max_len "
+                    f"{self.engine.max_len}; shrink the request, lower "
+                    f"spec_k, or grow max_len"
+                )
         if self._paged:
             # A request the POOL could never hold is a caller bug, like
             # the max_len checks above — raise at submit, not when the
@@ -788,7 +830,135 @@ class Server:
             )
         )
 
+    def _spec_tick(self) -> None:
+        """One speculative decode tick (ISSUE 13): draft k tokens per
+        live slot, verify all k+1 positions in ONE target pass, emit
+        each slot's longest accepted prefix plus the replacement/bonus
+        token — cache lengths advanced in-step by exactly the emitted
+        count (the rollback). Spanned as ``decode`` with nested
+        ``spec_draft`` / ``spec_verify`` spans (the ``attention=``
+        idiom rides all three), so the flight recorder attributes
+        draft vs verify work while the decode-phase roll-up — bench
+        denominators, the sentinel — still covers the whole tick."""
+        eng = self.engine
+        k = self._spec
+        active = np.zeros((eng.slots,), bool)
+        budget = np.ones((eng.slots,), np.int32)
+        eos = np.full((eng.slots,), -1, np.int32)
+        for slot, live in self.live.items():
+            active[slot] = True
+            budget[slot] = live.remaining_new()
+            if live.req.eos_id is not None:
+                eos[slot] = live.req.eos_id
+        if self._paged:
+            # Every page the verify span [fill, fill+k] can write must
+            # be privately owned BEFORE the step — the plain tick's COW
+            # probe, once per page in the span. Only the shared-prefix
+            # partial page can actually be shared, so at most one copy
+            # runs; the rest are no-op refcount probes.
+            ps = eng.page_size
+            caps = eng.allocator.mapped_tokens()
+            for slot, live in self.live.items():
+                fill = live.cache_fill()
+                last_pos = min(fill + k, int(caps[slot]) - 1)
+                for page_idx in range(fill // ps, last_pos // ps + 1):
+                    pair = eng.allocator.cow_before_write(
+                        slot, max(fill, page_idx * ps)
+                    )
+                    if pair is not None:
+                        eng.copy_page(*pair)
+                        obs.counter("kv_cow_copies")
+        n_live = int(active.sum())
+        rids = [live.req.rid for live in self.live.values()]
+        t0 = time.perf_counter()
+        with obs.span(
+            "decode", active=n_live, attention=self._attn_mode,
+            sampler=self._sampler, spec_k=k, rids=rids,
+        ):
+            with obs.span(
+                "spec_draft", active=n_live, attention=self._attn_mode,
+                sampler=self._sampler, rids=rids,
+            ):
+                eng.spec_draft(active, self._temp, self._topk)
+            t1 = time.perf_counter()
+            with obs.span(
+                "spec_verify", active=n_live, attention=self._attn_mode,
+                sampler=self._sampler, rids=rids,
+            ):
+                emit, n_emit, n_acc = eng.spec_verify(
+                    active, self._temp, self._topk, budget, eos
+                )
+        now = time.perf_counter()
+        if self.sentinel is not None:
+            self.sentinel.observe_phases(self.tick, decode=now - t0)
+        emitted = int(n_emit.sum())
+        accepted = int(n_acc.sum())
+        obs.counter("serve_tokens", float(emitted))
+        obs.counter("spec_drafted_tokens", float(k * n_live))
+        obs.counter("spec_accepted_tokens", float(accepted))
+        self._spec_emitted += emitted
+        self._spec_active_ticks += n_live
+        self._spec_drafted += k * n_live
+        self._spec_accepted += accepted
+        if self.stream is not None:
+            self.stream.inc("serve_tokens", float(emitted))
+            self.stream.observe("decode_tick", now - t0)
+            self.stream.observe("spec_draft_tick", t1 - t0)
+            self.stream.observe("spec_verify_tick", now - t1)
+            if n_live:
+                # Tokens emitted per slot-tick (1.0 = plain decode) —
+                # the throughput multiplier the record line carries —
+                # and the fraction of drafted tokens the target kept.
+                self.stream.observe(
+                    "accepted_tokens_per_tick", emitted / n_live
+                )
+                self.stream.observe(
+                    "draft_acceptance_rate", accepted / (k * n_live)
+                )
+        lens = np.asarray(
+            [live.cache_fill() for live in self.live.values()]
+        )
+        if self._attn_mode == "kernel":
+            # Same single-formula tile accounting as the plain tick,
+            # at the verify's T = k+1 query width.
+            bk = eng.decode_block_k
+            total = eng.max_len // bk
+            visited = num_kv_blocks(lens, k + 1, eng.max_len, bk)
+            n_free = eng.slots - lens.size
+            obs.counter(
+                "decode_blocks_skipped",
+                float(total * eng.slots - int(visited.sum()) - n_free),
+            )
+        ach = eng.decode_achieved_hbm_bytes(lens, t_q=k + 1)
+        if ach is not None:
+            self._decode_hbm_bytes += ach
+            obs.roofline.work("spec_verify", hbm_bytes=ach)
+            costs = getattr(eng, "roofline_costs", None) or {}
+            flops = costs.get("spec_verify", {}).get("flops", 0.0)
+            if self.stream is not None:
+                self.stream.inc("decode_hbm_bytes", ach)
+                if flops:
+                    self.stream.inc("decode_flops", flops)
+            if self._util_watch is not None and now > t1:
+                # The modeled bytes cover the VERIFY pass only, so the
+                # rate divides by the verify wall (t1 = draft/verify
+                # boundary) — over the whole tick a slow draft would
+                # structurally depress the rate and trip the sustained-
+                # collapse watch on a healthy engine.
+                self._util_watch.observe(
+                    "decode_hbm_gbps", self.tick, ach / (now - t1) / 1e9
+                )
+        for slot in list(self.live):
+            n = int(n_emit[slot])
+            self.live[slot].tokens.extend(
+                int(t) for t in emit[slot, :n]
+            )
+            self._maybe_retire(slot, now)
+
     def _decode_tick(self) -> None:
+        if self._spec:
+            self._spec_tick()
+            return
         active = np.zeros((self.engine.slots,), bool)
         for slot in self.live:
             active[slot] = True
@@ -1075,6 +1245,20 @@ class Server:
             out["decode_hbm_bytes_modeled"] = round(
                 self._decode_hbm_bytes, 1
             )
+        if self._spec:
+            # The speculative roll-up (ISSUE 13): tokens emitted per
+            # slot-tick (1.0 = plain decode — the throughput
+            # multiplier) and the drafted-token acceptance fraction.
+            out["spec_k"] = self._spec
+            out["spec_drafted_tokens"] = self._spec_drafted
+            out["spec_accepted_tokens"] = self._spec_accepted
+            if self._spec_active_ticks:
+                out["accepted_tokens_per_tick"] = round(
+                    self._spec_emitted / self._spec_active_ticks, 4
+                )
+                out["draft_acceptance_rate"] = round(
+                    self._spec_accepted / max(self._spec_drafted, 1), 4
+                )
         if self._paged:
             alloc = self.engine.allocator
             out.update(

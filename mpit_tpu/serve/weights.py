@@ -20,6 +20,7 @@ side:
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Mapping
 
 import jax
@@ -27,7 +28,12 @@ import jax.numpy as jnp
 
 from mpit_tpu.models.gpt2 import GPT2Config
 
-__all__ = ["expected_param_shapes", "infer_config", "load_gpt2_params"]
+__all__ = [
+    "draft_from_target",
+    "expected_param_shapes",
+    "infer_config",
+    "load_gpt2_params",
+]
 
 
 def expected_param_shapes(cfg: GPT2Config) -> dict[str, tuple[int, ...]]:
@@ -108,6 +114,36 @@ def validate_params(cfg: GPT2Config, params: Mapping) -> None:
             "dense checkpoint does not match the serve param contract: "
             f"missing={missing} extra={extra} shape-mismatch={wrong}"
         )
+
+
+def draft_from_target(params: Mapping, cfg: GPT2Config, num_layers: int):
+    """Layer-truncated self-draft (ISSUE 13): the first ``num_layers``
+    transformer blocks of a target checkpoint, sharing its embeddings,
+    final LayerNorm and (un)tied head — a draft with no separate
+    checkpoint, in the early-exit / self-speculation family. The
+    truncation is cheap on purpose (references, not copies — the
+    shared leaves serve both models) and by construction satisfies
+    every draft/target compatibility check the engine enforces
+    (identical vocab, covering positional table).
+
+    Returns ``(draft_params, draft_cfg)`` ready for
+    ``Engine(spec_k=..., draft_params=..., draft_cfg=...)``.
+    """
+    if not 1 <= num_layers < cfg.num_layers:
+        raise ValueError(
+            f"draft_from_target needs 1 <= num_layers < target layers "
+            f"({cfg.num_layers}), got {num_layers} — an equal-depth "
+            f"draft costs what the target costs and speculation buys "
+            f"nothing"
+        )
+    out: dict[str, Any] = {
+        str(k): v
+        for k, v in params.items()
+        if not str(k).startswith("block_")
+    }
+    for i in range(num_layers):
+        out[f"block_{i}"] = params[f"block_{i}"]
+    return out, dataclasses.replace(cfg, num_layers=num_layers)
 
 
 def load_gpt2_params(path: str, *, num_heads: int = 0, **overrides):

@@ -33,6 +33,88 @@ import pytest  # noqa: E402
 # rebuilds an identical step inside one process.
 
 
+# ---------------------------------------------------------------------------
+# Tier-1 wall-time guard (ISSUE 13 satellite). The tier-1 driver kills
+# the suite at a hard 870 s; the budget was already breached once (PR 8
+# HEAD) and the failure mode is a silent timeout-kill — the run just
+# dies, with no record of which tests grew. This plugin makes the
+# regression visible INSIDE the suite: every run prints wall vs budget
+# plus the slowest tests, and a default-tier run (``-m "not slow"``,
+# the driver-timed shape) whose wall projects past the budget FAILS
+# loudly here, where the offending tests are named, before the driver's
+# kill eats the cap. Override the budget with MPIT_T1_BUDGET_S; the
+# failure threshold is 92% of it (the remaining 8% is collection +
+# teardown + machine variance headroom).
+# ---------------------------------------------------------------------------
+
+import time as _time
+
+_T1_GUARD: dict = {"t0": None, "durations": []}
+_T1_FAIL_FRACTION = 0.92
+
+
+def _t1_budget_s() -> float:
+    return float(os.environ.get("MPIT_T1_BUDGET_S", "870"))
+
+
+def _t1_is_default_tier(config) -> bool:
+    """Only the driver-timed shape fails on projection: the marker
+    expression excludes slow tests and nothing re-includes them."""
+    expr = config.getoption("-m", default="") or ""
+    return "not slow" in expr and "slow or" not in expr
+
+
+def pytest_sessionstart(session):
+    _T1_GUARD["t0"] = _time.time()
+    _T1_GUARD["durations"] = []
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        _T1_GUARD["durations"].append((report.duration, report.nodeid))
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if _T1_GUARD["t0"] is None:
+        return
+    wall = _time.time() - _T1_GUARD["t0"]
+    budget = _t1_budget_s()
+    frac = wall / budget
+    tr = terminalreporter
+    tr.section("tier-1 wall-time guard")
+    tr.line(
+        f"suite wall {wall:.1f}s of {budget:.0f}s budget "
+        f"({100 * frac:.0f}%); fails past "
+        f"{100 * _T1_FAIL_FRACTION:.0f}% on the default tier"
+    )
+    slowest = sorted(_T1_GUARD["durations"], reverse=True)[:10]
+    for dur, nodeid in slowest:
+        tr.line(f"  {dur:7.2f}s  {nodeid}")
+    if frac > _T1_FAIL_FRACTION and _t1_is_default_tier(config):
+        tr.line(
+            "TIER-1 WALL-TIME BUDGET PROJECTED EXCEEDED: trim or mark "
+            "`slow` the tests above (the driver hard-kills at "
+            f"{budget:.0f}s and records nothing).",
+            red=True,
+            bold=True,
+        )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _T1_GUARD["t0"] is None:
+        return
+    wall = _time.time() - _T1_GUARD["t0"]
+    if (
+        wall / _t1_budget_s() > _T1_FAIL_FRACTION
+        and _t1_is_default_tier(session.config)
+        and exitstatus == 0
+    ):
+        # Loud failure while the suite can still name the culprits —
+        # wrap_session returns session.exitstatus, so this flips the
+        # run red without touching any test's own verdict.
+        session.exitstatus = 1
+
+
 @pytest.fixture(scope="session")
 def n_devices() -> int:
     return jax.device_count()
