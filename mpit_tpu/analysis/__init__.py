@@ -1,0 +1,251 @@
+"""mpit_tpu.analysis — the repo-native static contract checker (ISSUE 14).
+
+Thirteen PRs of informal invariants — "no ``[slots, vocab]`` logits in
+the decode jaxpr", "every async copy started is waited", "restage
+before the capacity token releases", "utilization percentages only on
+TPU", "pinned seams consume no wall clock" — enforced mechanically
+across the whole package, the way a sanitizer would be in a C++ stack.
+
+Four passes, one CLI, one exit-code grammar (0 clean / 1 violations /
+2 unusable):
+
+- :mod:`.lint` — AST rules over the package's host code (hot-seam
+  host-sync, per-tick jit, determinism seams, utilization gates,
+  thread binding).
+- :mod:`.jaxpr_check` — the reusable jaxpr-contract library (the
+  serving tests' aval greps, audited and shared) + a sweep tracing
+  every registered jitted step against its declared contracts.
+- :mod:`.kernel_check` — the Pallas kernel verifier: DMA-semaphore
+  balance, the ``_Ring`` restage-before-release ordering, planner tile
+  math + VMEM pins, and the exhaustive ``_Ring`` protocol model check
+  (P ∈ {2,3,4}).
+- :mod:`.lockdep` — the runtime lock-order auditor (a pytest hook
+  keeps it on for the threaded suites; cycles fail loudly, named).
+
+CLI::
+
+    python -m mpit_tpu.analysis [paths...] [--rule R]... [--changed]
+    python -m mpit_tpu.analysis --list-rules
+
+``--changed`` scopes the sweep to files touched per ``git status`` —
+the pre-commit entry point. The full-package run is a tier-1 test
+(``tests/test_analysis.py``), so every future PR is checked against
+every invariant, not just the ones its author remembered.
+"""
+
+from __future__ import annotations
+
+from mpit_tpu.analysis.common import RULES, SourceFile, Violation
+
+__all__ = [
+    "RULES", "SourceFile", "Violation", "run", "collect_files",
+    "ChangedScopeError",
+]
+
+
+class ChangedScopeError(RuntimeError):
+    """--changed could not resolve the git change set (no repo / no
+    git): the analyzer cannot analyze, so it must NOT report clean —
+    surfaced as the exit-2 unusable verdict, never as an empty scope."""
+
+
+def _git_changed_set(anchor: str) -> set:
+    """Absolute real paths of every modified/untracked ``.py`` file in
+    the repository that owns ``anchor`` (a target path — NOT the
+    process cwd: a cwd in a different repo would intersect the wrong
+    change set and report silently 'clean'; review finding). Git names
+    are repo-root-relative, so they are re-anchored at the toplevel —
+    target paths may be absolute or cwd-relative and still intersect
+    correctly."""
+    import os
+    import subprocess
+
+    try:
+        top = subprocess.run(
+            ["git", "-C", anchor, "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        # -uall: plain porcelain collapses an untracked DIRECTORY to
+        # one "?? dir/" entry, which would silently drop every .py
+        # file inside a brand-new module from the pre-commit scope
+        # (review finding, reproduced on this very repo).
+        out = subprocess.run(
+            ["git", "-C", anchor, "status", "--porcelain",
+             "--untracked-files=all"],
+            capture_output=True, text=True, check=True,
+        ).stdout
+    except Exception as e:
+        raise ChangedScopeError(
+            f"--changed could not read the git change set: {e}"
+        ) from e
+    touched = set()
+    for line in out.splitlines():
+        name = line[3:].strip()
+        if " -> " in name:
+            name = name.split(" -> ")[-1]
+        if name.startswith('"') and name.endswith('"'):
+            # Porcelain C-quotes paths with spaces/escapes/non-ASCII;
+            # left quoted, such a file silently drops out of the
+            # pre-commit scope (review finding — the same silent-clean
+            # class as the -uall fix above).
+            name = (
+                name[1:-1]
+                .encode("utf-8")
+                .decode("unicode_escape")
+                .encode("latin-1")
+                .decode("utf-8", errors="replace")
+            )
+        if name.endswith(".py"):
+            touched.add(os.path.realpath(os.path.join(top, name)))
+    return touched
+
+
+def collect_files(paths, changed: bool = False) -> tuple[list, list]:
+    """Resolve target ``.py`` files. Returns ``(files, missing)``;
+    ``changed=True`` intersects with git's modified/untracked set
+    (raising :class:`ChangedScopeError` when git cannot answer)."""
+    import os
+
+    files: list[str] = []
+    missing: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        files.append(os.path.join(root, n))
+        elif os.path.isfile(p):
+            files.append(p)
+        else:
+            missing.append(p)
+    if changed:
+        anchor = "."
+        for p in paths:
+            if os.path.isdir(p):
+                anchor = p
+                break
+            if os.path.isfile(p):
+                anchor = os.path.dirname(p) or "."
+                break
+        touched = _git_changed_set(anchor)
+        files = [f for f in files if os.path.realpath(f) in touched]
+    # De-dup, stable order.
+    seen = set()
+    uniq = []
+    for f in files:
+        key = os.path.normpath(f)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    return uniq, missing
+
+
+def run(
+    paths=("mpit_tpu",),
+    rules: set | None = None,
+    changed: bool = False,
+    jaxpr_sweep: bool = True,
+    lint_config=None,
+) -> tuple[int, list]:
+    """Run the static passes; returns ``(exit_code, violations)``.
+
+    ``jaxpr_sweep`` / the kernel dynamic pins import jax and the ops
+    modules (tracing only); they run once per invocation when any
+    package path is in scope, and are skipped entirely in ``--changed``
+    mode with an empty change set.
+    """
+    import os
+
+    from mpit_tpu.analysis import kernel_check, lint
+    from mpit_tpu.analysis.common import Violation as V
+
+    try:
+        files, missing = collect_files(paths, changed=changed)
+    except ChangedScopeError as e:
+        # No git answer ⇒ unusable (exit 2), never a silent "clean".
+        return 2, [V("analysis", "--changed", 0, str(e))]
+    violations: list = []
+    unusable = False
+    for m in missing:
+        unusable = True
+        violations.append(V("analysis", m, 0, "path does not exist"))
+    cfg = lint_config if lint_config is not None else lint.DEFAULT_CONFIG
+
+    any_kernel_file = False
+    for path in files:
+        try:
+            sf = SourceFile(path)
+        except (OSError, UnicodeDecodeError, ValueError) as e:
+            # Unreadable OR undecodable (a PEP-263 non-UTF8 source is
+            # legal Python the reader can't decode) ⇒ exit-2 unusable,
+            # never a traceback miscoded as a findings exit (review
+            # finding).
+            unusable = True
+            violations.append(V("analysis", path, 0, f"unreadable: {e}"))
+            continue
+        if sf.tree is None:
+            unusable = True
+            violations.append(
+                V("analysis", path, 0, f"syntax error: {sf.parse_error}")
+            )
+            continue
+        violations.extend(lint.lint_file(sf, cfg, rules))
+        norm = path.replace("\\", "/")
+        if any(norm.endswith(k) for k in kernel_check.KERNEL_FILES) or (
+            sf.directives.get("pallas-kernel")
+        ):
+            any_kernel_file = True
+            if rules is None or rules & {
+                kernel_check.R_DMA, kernel_check.R_RING_ORDER
+            }:
+                violations.extend(kernel_check.check_kernels_ast(sf))
+
+    if files:
+        want_dynamic = rules is None or rules & {
+            kernel_check.R_GEOMETRY, kernel_check.R_MODEL
+        }
+        if want_dynamic and any_kernel_file:
+            violations.extend(kernel_check.check_kernels_dynamic(rules))
+        from mpit_tpu.analysis.jaxpr_check import R_JAXPR, sweep
+
+        # The traced-contract sweep runs on a full-package invocation,
+        # and in --changed mode only when a contract-bearing layer was
+        # actually touched (serve/ops/train/models) — the pre-commit
+        # path stays fast on doc/host-only edits. Package membership is
+        # resolved against the REAL package directory, not a path
+        # substring (review finding: a clone under a parent dir named
+        # "mpit_tpu" ran the sweep for every single-file invocation).
+        pkg_root = os.path.dirname(os.path.dirname(os.path.realpath(__file__)))
+
+        def _pkg_rel(f):
+            rf = os.path.realpath(f)
+            if rf.startswith(pkg_root + os.sep):
+                return rf[len(pkg_root) + 1:].replace(os.sep, "/")
+            return None
+
+        rels = [r for r in map(_pkg_rel, files) if r is not None]
+        touched_contract = any(
+            r.startswith(("serve/", "ops/", "train/", "models/"))
+            for r in rels
+        )
+        if (
+            jaxpr_sweep
+            and (rules is None or R_JAXPR in rules)
+            and ((not changed and rels) or touched_contract)
+        ):
+            violations.extend(sweep())
+
+    if rules is not None:
+        # Global --rule guarantee: no pass may leak a non-selected
+        # rule's findings (check_kernels_ast emits both kernel AST
+        # rules; lint filters itself — this is the one enforcement
+        # point). The synthetic "analysis" unusable markers always
+        # survive.
+        violations = [
+            v for v in violations if v.rule in rules or v.rule == "analysis"
+        ]
+    if unusable:
+        return 2, violations
+    return (1 if violations else 0), violations

@@ -1,0 +1,96 @@
+"""CLI: ``python -m mpit_tpu.analysis [--rule ...] [--changed] [paths]``.
+
+Exit codes — the same grammar as ``python -m mpit_tpu.obs diff``:
+
+- ``0`` — clean: every rule passed over the selected files.
+- ``1`` — violations: printed one per line as ``path:line: [rule] msg``.
+- ``2`` — unusable: a target path is missing/unreadable/unparseable,
+  or an unknown rule was requested (an analyzer that cannot analyze
+  must not report "clean").
+
+``--changed`` scopes to files modified per ``git status --porcelain``
+(staged, unstaged and untracked) — the pre-commit entry point; an
+empty change set exits 0 immediately. ``--no-jaxpr`` skips the
+traced-contract sweep (the AST passes need no jax import beyond what
+the package already loads).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.analysis",
+        description="repo-native static contract checker (ISSUE 14)",
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=None,
+        help="files/directories to analyze (default: mpit_tpu)",
+    )
+    ap.add_argument(
+        "--rule", action="append", default=None, metavar="RULE",
+        help="run only this rule (repeatable; --list-rules for names)",
+    )
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="scope to git-modified/untracked .py files (pre-commit mode)",
+    )
+    ap.add_argument(
+        "--no-jaxpr", action="store_true",
+        help="skip the traced jaxpr-contract sweep",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = ap.parse_args(argv)
+
+    # Importing the passes registers their rules.
+    from mpit_tpu import analysis
+    from mpit_tpu.analysis import common, jaxpr_check, kernel_check, lint  # noqa: F401
+
+    if args.list_rules:
+        width = max(len(r) for r in common.RULES)
+        for name in sorted(common.RULES):
+            print(f"{name:<{width}}  {common.RULES[name]}")
+        print(f"{'lockdep':<{width}}  runtime lock-order auditor — not a "
+              "static pass; enabled under pytest for the threaded suites")
+        return 0
+
+    rules = None
+    if args.rule:
+        rules = set(args.rule)
+        unknown = rules - set(common.RULES)
+        if unknown:
+            print(
+                f"unknown rule(s): {', '.join(sorted(unknown))} "
+                "(see --list-rules)",
+                file=sys.stderr,
+            )
+            return 2
+
+    paths = args.paths or ["mpit_tpu"]
+    code, violations = analysis.run(
+        paths,
+        rules=rules,
+        changed=args.changed,
+        jaxpr_sweep=not args.no_jaxpr,
+    )
+    for v in violations:
+        print(v.format())
+    if code == 0:
+        scope = "changed files" if args.changed else ", ".join(paths)
+        print(f"analysis clean over {scope}")
+    else:
+        print(
+            f"{len(violations)} violation(s)"
+            + (" (analysis unusable)" if code == 2 else ""),
+            file=sys.stderr,
+        )
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,161 @@
+"""Shared plumbing for the static contract checker (ISSUE 14).
+
+One :class:`Violation` shape for every pass (lint / kernel / jaxpr /
+lockdep), one suppression syntax, one in-file directive syntax:
+
+- ``# analysis: allow(<rule>) <reason>`` on a line (or the line above
+  it) suppresses that rule's violation at that line. ``allow(*)``
+  suppresses every rule. A reason is not enforced but the repo
+  convention is to state the invariant that makes the site deliberate
+  (the suppression IS documentation — e.g. an engine step wrapper's
+  completion fence).
+- ``# analysis: hot-seam`` / ``# analysis: determinism-seam`` /
+  ``# analysis: pallas-kernel`` — role directives. On (or immediately
+  above) a ``def`` line they mark that function; on a bare line they
+  mark the whole module. The repo's own seams are named centrally in
+  ``lint.DEFAULT_CONFIG`` so package files need no markers; directives
+  are the extension mechanism (new modules, the test corpus).
+
+Exit-code contract (the CLI's and the tier-1 test's): 0 = clean,
+1 = violations, 2 = unusable (unreadable / unparseable target, bad
+invocation) — the same 0/1/2 grammar as ``python -m mpit_tpu.obs diff``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+__all__ = [
+    "Violation",
+    "SourceFile",
+    "RULES",
+    "register_rule",
+    "qualname_visit",
+]
+
+# Registry: rule name -> one-line description (the CLI's --list-rules).
+RULES: dict[str, str] = {}
+
+
+def register_rule(name: str, description: str) -> str:
+    RULES[name] = description
+    return name
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding: ``path:line: [rule] message``."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+_ALLOW_RE = re.compile(r"#\s*analysis:\s*allow\(([\w*-]+)\)")
+_DIRECTIVE_RE = re.compile(r"#\s*analysis:\s*([\w-]+)\s*$")
+
+
+class SourceFile:
+    """A parsed target: source, AST, suppressions and role directives.
+
+    Parsing happens once per file per sweep; every pass shares the
+    instance. ``tree`` is ``None`` when the file does not parse —
+    callers surface that as the exit-2 "unusable" verdict, never as a
+    silent skip.
+    """
+
+    def __init__(self, path: str, text: str | None = None):
+        self.path = path
+        if text is None:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.parse_error: str | None = None
+        try:
+            self.tree: ast.Module | None = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        # line -> set of allowed rule names ("*" = all)
+        self._allow: dict[int, set[str]] = {}
+        # role -> line numbers carrying the directive
+        self.directives: dict[str, list[int]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            for m in _ALLOW_RE.finditer(line):
+                self._allow.setdefault(i, set()).add(m.group(1))
+            m = _DIRECTIVE_RE.search(line)
+            if m and m.group(1) != "allow":
+                self.directives.setdefault(m.group(1), []).append(i)
+
+    # -- suppression ------------------------------------------------------
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A violation at ``line`` is suppressed by an allow() on that
+        line or the line directly above it (the comment-above idiom)."""
+        for ln in (line, line - 1):
+            allowed = self._allow.get(ln)
+            if allowed and (rule in allowed or "*" in allowed):
+                return True
+        return False
+
+    # -- directives -------------------------------------------------------
+
+    def module_role(self, role: str) -> bool:
+        """True when the module carries a bare ``# analysis: <role>``
+        line at module level (not attached to a def)."""
+        for ln in self.directives.get(role, []):
+            if not self._def_at_or_below(ln):
+                return True
+        return False
+
+    def func_role(self, role: str, func_line: int) -> bool:
+        """True when the directive sits on the ``def`` line or the line
+        directly above it."""
+        return any(
+            ln in (func_line, func_line - 1)
+            for ln in self.directives.get(role, [])
+        )
+
+    def _def_at_or_below(self, ln: int) -> bool:
+        for probe in (ln, ln + 1):
+            if 1 <= probe <= len(self.lines) and re.match(
+                r"\s*(async\s+)?def\s", self.lines[probe - 1]
+            ):
+                return True
+        return False
+
+    def violation(self, rule: str, node_or_line, message: str):
+        """Build a Violation unless suppressed; returns None when
+        suppressed."""
+        line = getattr(node_or_line, "lineno", node_or_line)
+        if self.suppressed(rule, line):
+            return None
+        return Violation(rule=rule, path=self.path, line=line, message=message)
+
+
+def qualname_visit(tree: ast.Module):
+    """Yield ``(qualname, FunctionDef)`` for every function in the
+    module, with ``Class.method`` / ``outer.<locals>.inner`` spelling
+    collapsed to dotted names (``Class.method``, ``outer.inner``)."""
+    out: list[tuple[str, ast.AST]] = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append((q, child))
+                walk(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out

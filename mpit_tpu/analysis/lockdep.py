@@ -1,0 +1,306 @@
+"""Lock-order auditor for the host concurrency layer (ISSUE 14 pass 4).
+
+The repo's threaded code — the compat simulator's rank threads and
+mailbox Conditions, the obs Recorder, the elastic anchor server, the
+prefetch pipeline — holds locks in nested orders that are correct by
+convention only. This module records the ACTUAL acquisition order per
+thread behind a test-only hook and fails on cycles in the lock-order
+graph: the classic lockdep idea (two locks ever taken in both orders =
+a latent deadlock, whether or not this run interleaved into it).
+
+Usage (the pytest hook in ``tests/conftest.py`` keeps it enabled for
+the threaded suites ``test_compat.py`` / ``test_elastic.py``):
+
+    from mpit_tpu.analysis import lockdep
+    lockdep.install()          # wrap locks created by mpit_tpu code
+    ...                        # run the threaded workload
+    cycles = lockdep.cycles()  # [] when the order is consistent
+    lockdep.uninstall()
+
+Mechanics: ``install()`` patches ``threading.Lock`` / ``RLock`` /
+``Condition`` with factories that return recording proxies — but ONLY
+when the creating frame lives inside the target package (default
+``mpit_tpu``), so pytest/stdlib internals stay untouched. Lock
+identity is the CREATION SITE (``file:line``): every ``Comm``'s
+mailbox lock is one node, which is what makes the order graph about
+code paths, not object instances. On each acquire, an edge
+``held_site -> new_site`` is added for every distinct site currently
+held by the thread; :func:`cycles` runs cycle detection over the
+graph and names the witness stacks.
+
+Limitations (documented, not silent): same-site nesting (two instances
+from one creation site held together) is recorded under
+``self_nesting`` rather than as a cycle — ranked instance order can't
+be inferred statically; and locks created BEFORE ``install()`` are
+invisible. Proxies left over after ``uninstall()`` keep delegating but
+stop recording (the enabled flag is global), so install/uninstall per
+test is safe.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+
+__all__ = [
+    "install",
+    "uninstall",
+    "reset",
+    "cycles",
+    "self_nesting",
+    "format_cycles",
+    "LockOrderError",
+]
+
+
+class LockOrderError(RuntimeError):
+    """Raised by :func:`check` when the lock-order graph has a cycle."""
+
+
+class _State:
+    def __init__(self):
+        self.enabled = False
+        self.package = "mpit_tpu"
+        self.orig = None  # (Lock, RLock, Condition)
+        # site -> {site2: (stack_excerpt, thread_name)}
+        self.edges: dict[str, dict[str, str]] = {}
+        self.self_nesting: dict[str, str] = {}
+        self.local = threading.local()
+        self.graph_lock = threading.Lock()
+
+
+_S = _State()
+
+
+def _held_stack():
+    st = getattr(_S.local, "held", None)
+    if st is None:
+        st = _S.local.held = []
+    return st
+
+
+def _caller_site(depth: int = 2) -> str | None:
+    """Creation site of the lock: nearest frame inside the target
+    package (skipping this module and threading)."""
+    f = sys._getframe(depth)
+    pkg = os.sep + _S.package + os.sep
+    while f is not None:
+        fn = f.f_code.co_filename
+        if (
+            pkg in fn
+            and "analysis" + os.sep + "lockdep" not in fn
+            and not fn.endswith("threading.py")
+        ):
+            return f"{os.path.relpath(fn)}:{f.f_lineno}"
+        f = f.f_back
+    return None
+
+
+def _excerpt() -> str:
+    return "".join(traceback.format_stack(limit=8)[:-2])
+
+
+class _Proxy:
+    """Recording lock proxy. Supports the Lock/RLock surface the repo
+    (and threading.Condition) uses."""
+
+    def __init__(self, real, site: str):
+        self._real = real
+        self._site = site
+
+    # -- recording --------------------------------------------------------
+
+    def _on_acquired(self):
+        if not _S.enabled:
+            return
+        held = _held_stack()
+        my = self._site
+        reentrant = any(prior is self for prior in held)
+        if not reentrant:
+            with _S.graph_lock:
+                for prior in held:
+                    if prior._site == my:
+                        _S.self_nesting.setdefault(my, _excerpt())
+                    else:
+                        _S.edges.setdefault(prior._site, {}).setdefault(
+                            my,
+                            f"[{threading.current_thread().name}]\n"
+                            f"{_excerpt()}",
+                        )
+        # Reentrant RLock acquires still push (release pops pairwise).
+        held.append(self)
+
+    def _on_released(self):
+        held = _held_stack()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                break
+
+    # -- lock surface -----------------------------------------------------
+
+    def acquire(self, *a, **kw):
+        got = self._real.acquire(*a, **kw)
+        if got:
+            self._on_acquired()
+        return got
+
+    def release(self):
+        self._real.release()
+        self._on_released()
+
+    def locked(self):
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition integration: delegate RLock ownership queries and keep
+    # the held bookkeeping coherent across wait()'s release/reacquire.
+    def _is_owned(self):
+        if hasattr(self._real, "_is_owned"):
+            return self._real._is_owned()
+        if self._real.acquire(False):
+            self._real.release()
+            return False
+        return True
+
+    def _release_save(self):
+        if hasattr(self._real, "_release_save"):
+            saved = self._real._release_save()
+        else:
+            self._real.release()
+            saved = None
+        self._on_released()
+        return saved
+
+    def _acquire_restore(self, saved):
+        if hasattr(self._real, "_acquire_restore"):
+            self._real._acquire_restore(saved)
+        else:
+            self._real.acquire()
+        self._on_acquired()
+
+    def __repr__(self):
+        return f"<lockdep proxy {self._site} of {self._real!r}>"
+
+
+def _wrap_factory(orig_factory):
+    def factory(*a, **kw):
+        real = orig_factory(*a, **kw)
+        if not _S.enabled:
+            return real
+        site = _caller_site()
+        if site is None:
+            return real
+        return _Proxy(real, site)
+
+    return factory
+
+
+def install(package: str = "mpit_tpu") -> None:
+    """Patch the lock factories; locks created from ``package`` code
+    after this call are recorded. Idempotent."""
+    if _S.orig is not None:
+        _S.enabled = True
+        return
+    _S.package = package
+    _S.orig = (threading.Lock, threading.RLock, threading.Condition)
+    lock_f = _wrap_factory(_S.orig[0])
+    rlock_f = _wrap_factory(_S.orig[1])
+    orig_cond = _S.orig[2]
+
+    def cond_factory(lock=None):
+        if lock is None and _S.enabled and _caller_site() is not None:
+            lock = rlock_f()
+        return orig_cond(lock) if lock is not None else orig_cond()
+
+    threading.Lock = lock_f
+    threading.RLock = rlock_f
+    threading.Condition = cond_factory
+    _S.enabled = True
+
+
+def uninstall() -> None:
+    """Restore the factories. Existing proxies keep delegating but stop
+    recording."""
+    _S.enabled = False
+    if _S.orig is not None:
+        threading.Lock, threading.RLock, threading.Condition = _S.orig
+        _S.orig = None
+
+
+def reset() -> None:
+    """Clear the recorded graph (per-test isolation)."""
+    with _S.graph_lock:
+        _S.edges.clear()
+        _S.self_nesting.clear()
+
+
+def self_nesting() -> dict:
+    with _S.graph_lock:
+        return dict(_S.self_nesting)
+
+
+def cycles() -> list:
+    """Cycles in the lock-order graph, each a list of sites
+    ``[a, b, ..., a]``. Empty = globally consistent order."""
+    with _S.graph_lock:
+        graph = {k: list(v) for k, v in _S.edges.items()}
+    out = []
+    seen_cycles = set()
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+
+    def dfs(node, path):
+        color[node] = GRAY
+        for nxt in graph.get(node, ()):  # noqa: B007
+            c = color.get(nxt, WHITE)
+            if c == GRAY:
+                i = path.index(nxt)
+                cyc = tuple(path[i:] + [nxt])
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    out.append(list(cyc))
+            elif c == WHITE:
+                dfs(nxt, path + [nxt])
+        color[node] = BLACK
+
+    for node in list(graph):
+        if color.get(node, WHITE) == WHITE:
+            dfs(node, [node])
+    return out
+
+
+def witnesses(cycle: list) -> list:
+    """The recorded stacks behind each edge of one cycle."""
+    with _S.graph_lock:
+        return [
+            _S.edges.get(a, {}).get(b, "<no witness>")
+            for a, b in zip(cycle, cycle[1:])
+        ]
+
+
+def format_cycles(cyc: list) -> str:
+    lines = []
+    for c in cyc:
+        lines.append("lock-order cycle: " + " -> ".join(c))
+        for (a, b), w in zip(zip(c, c[1:]), witnesses(c)):
+            first = w.strip().splitlines()
+            lines.append(f"  edge {a} -> {b} acquired {first[0] if first else ''}")
+    return "\n".join(lines)
+
+
+def check() -> None:
+    """Raise :class:`LockOrderError` naming the cycle(s), if any."""
+    cyc = cycles()
+    if cyc:
+        raise LockOrderError(format_cycles(cyc))

@@ -90,6 +90,7 @@ from mpit_tpu.ops.lm_head import lm_head_sample, lm_head_verify
 from mpit_tpu.serve.spec import (
     accept_emit,
     draft_distribution,
+    modified_logits,
     verify_reference,
 )
 from mpit_tpu.serve.kvcache import (
@@ -122,17 +123,13 @@ def sample_tokens(logits, key, temperature, top_k):
     k highest-logit tokens (per slot; 0 = full vocab). All slots draw
     from one key (jax.random.categorical is row-independent noise).
     """
-    vocab = logits.shape[-1]
     greedy = temperature <= 0.0
-    # Per-slot top-k: threshold at each slot's k-th largest logit.
-    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
-    k_idx = jnp.clip(top_k - 1, 0, vocab - 1)
-    thresh = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
-    masked = jnp.where(
-        (top_k[:, None] > 0) & (logits < thresh), -jnp.inf, logits
+    # Per-slot top-k threshold + temperature: the ONE shared
+    # modification (serve/spec.py) — the speculative proposal q must be
+    # exactly this distribution, so both read the same implementation.
+    sampled = jax.random.categorical(
+        key, modified_logits(logits, temperature, top_k), axis=-1
     )
-    temp = jnp.maximum(temperature, 1e-6)[:, None]
-    sampled = jax.random.categorical(key, masked / temp, axis=-1)
     return jnp.where(
         greedy, jnp.argmax(logits, axis=-1), sampled
     ).astype(jnp.int32)
@@ -1143,6 +1140,9 @@ class Engine:
             self.cache, self.last_token = self.compile_watch.call(
                 "prefill", self._prefill_jit, *args
             )
+        # The step's one deliberate completion fence (docstring
+        # contract: the fetch closes the caller's span).
+        # analysis: allow(host-sync-in-hot-seam)
         return np.asarray(self.last_token)
 
     def prefill_paged(
@@ -1182,6 +1182,9 @@ class Engine:
             self.cache, self.last_token = self.compile_watch.call(
                 "prefill", self._prefill_paged_jit, *args
             )
+        # The step's one deliberate completion fence (docstring
+        # contract: the fetch closes the caller's span).
+        # analysis: allow(host-sync-in-hot-seam)
         return np.asarray(self.last_token)
 
     def copy_page(self, src: int, dst: int) -> None:
@@ -1228,6 +1231,9 @@ class Engine:
         self.draft_cache, drafted, qx, qprobs = self.compile_watch.call(
             "spec_draft", self._spec_draft_jit, *args
         )
+        # The draft phase's deliberate fence (span wall must cover
+        # real draft work).
+        # analysis: allow(host-sync-in-hot-seam)
         jax.block_until_ready(drafted)
         self._spec_state = (drafted, qx, qprobs)
 
@@ -1273,6 +1279,9 @@ class Engine:
         self.draft_cache = type(dc)(
             k=dc.k, v=dc.v, lengths=self.cache.lengths
         )
+        # The verify step's deliberate completion fence (docstring
+        # contract).
+        # analysis: allow(host-sync-in-hot-seam)
         return np.asarray(emit), np.asarray(n_emit), np.asarray(n_acc)
 
     def decode(self, active, temp, topk) -> np.ndarray:
@@ -1296,6 +1305,9 @@ class Engine:
                 jnp.asarray(temp, jnp.float32),
                 jnp.asarray(topk, jnp.int32),
             )
+            # The step's one deliberate completion fence (docstring
+            # contract: the fetch closes the caller's span).
+            # analysis: allow(host-sync-in-hot-seam)
             return np.asarray(self.last_token)
         self.cache, self.last_token = self.compile_watch.call(
             "decode",
@@ -1308,6 +1320,9 @@ class Engine:
             jnp.asarray(temp, jnp.float32),
             jnp.asarray(topk, jnp.int32),
         )
+        # The step's one deliberate completion fence (docstring
+        # contract: the fetch closes the caller's span).
+        # analysis: allow(host-sync-in-hot-seam)
         return np.asarray(self.last_token)
 
     # -- roofline accounting (ISSUE 8) --------------------------------------

@@ -49,10 +49,31 @@ from jax import lax
 __all__ = [
     "accept_emit",
     "draft_distribution",
+    "modified_logits",
     "verify_reference",
 ]
 
 _NEG_BIG = -1e30  # exp underflows to exactly 0.0 in f32 (kernel idiom)
+
+
+def modified_logits(logits, temperature, top_k):
+    """The per-slot top-k/temperature logit modification — ONE
+    implementation shared by the engine's sampler
+    (:func:`mpit_tpu.serve.engine.sample_tokens`) and the speculative
+    proposal q below. Rejection-sampling exactness REQUIRES q to be
+    exactly the distribution the engine draws from; sharing the math
+    (rather than mirroring it) makes that a structural fact instead of
+    a convention. Per slot: threshold at the k-th largest logit when
+    ``top_k > 0``, then divide by ``max(temperature, 1e-6)``."""
+    vocab = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(top_k - 1, 0, vocab - 1)
+    thresh = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    masked = jnp.where(
+        (top_k[:, None] > 0) & (logits < thresh), -jnp.inf, logits
+    )
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    return masked / temp
 
 
 def draft_distribution(logits, temperature, top_k):
@@ -66,15 +87,7 @@ def draft_distribution(logits, temperature, top_k):
     token is an exact q sample). Greedy rows (``temperature <= 0``) are
     accepted by argmax equality, never through q — their near-delta
     probs are computed but unused."""
-    vocab = logits.shape[-1]
-    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
-    k_idx = jnp.clip(top_k - 1, 0, vocab - 1)
-    thresh = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
-    masked = jnp.where(
-        (top_k[:, None] > 0) & (logits < thresh), -jnp.inf, logits
-    )
-    temp = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = masked / temp
+    scaled = modified_logits(logits, temperature, top_k)
     probs = jax.nn.softmax(scaled, axis=-1)
     return probs, scaled
 

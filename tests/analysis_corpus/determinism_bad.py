@@ -1,0 +1,12 @@
+"""Corpus: determinism-seam fires exactly once — a wall-clock read in
+a seeded-trace module silently makes every caller's trace a function
+of the machine, not of (spec, seed)."""
+
+# analysis: determinism-seam
+
+import time
+
+
+def generate_arrivals(spec, seed):
+    jitter = time.time() % 1.0                # VIOLATION: wall clock
+    return [spec.rate + jitter]

@@ -1,0 +1,16 @@
+"""Corpus false-positive guard: seeded RNG streams are the contract,
+not a violation — RandomState(seed), default_rng(seed), random.Random
+instances (the loadgen / FaultPlan idiom)."""
+
+# analysis: determinism-seam
+
+import random
+
+import numpy as np
+
+
+def generate_arrivals(spec, seed):
+    rng = np.random.RandomState(seed)
+    alt = np.random.default_rng(seed)
+    py = random.Random(seed)
+    return rng.poisson(spec.rate), alt.integers(8), py.random()

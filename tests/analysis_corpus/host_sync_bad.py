@@ -1,0 +1,14 @@
+"""Corpus: host-sync-in-hot-seam fires exactly once.
+
+A tick-shaped function fetches a jitted step's result with ``float()``
+outside any labeled fence — the exact recompile-era bug class the rule
+exists for. (Parsed by the analyzer, never imported — the names are
+props.)
+"""
+
+
+# analysis: hot-seam
+def decode_tick(engine, batch, obs):
+    tokens = engine.step_jit(batch)          # device value
+    total = float(tokens.sum())              # VIOLATION: unlabeled sync
+    return total

@@ -1,0 +1,21 @@
+"""Corpus false-positive guard: the known-good idioms stay clean.
+
+- ``float()`` on a genuinely host value (a numpy percentile) in a hot
+  seam;
+- a device fetch inside the labeled ``obs.span("host_fence")`` block
+  (the hardened_loop convention);
+- a suppressed deliberate fence with the reason stated.
+"""
+
+
+# analysis: hot-seam
+def decode_tick(engine, batch, np, obs):
+    lat = np.percentile(batch["lat"], 95)
+    p95 = float(lat)                          # host scalar: fine
+    metrics = engine.step_jit(batch)
+    with obs.span("host_fence", why="log"):
+        loss = float(metrics["loss"])         # labeled fence: fine
+    # deliberate completion fence, reason stated:
+    # analysis: allow(host-sync-in-hot-seam)
+    out = np.asarray(metrics["tokens"])
+    return p95, loss, out

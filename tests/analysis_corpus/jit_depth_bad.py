@@ -1,0 +1,11 @@
+"""Corpus: jit-in-hot-seam fires exactly once — a jax.jit constructed
+inside a per-tick function recompiles on every call (the "two compiles
+for the engine's lifetime" discipline, violated)."""
+
+import jax
+
+
+# analysis: hot-seam
+def decode_tick(engine, batch):
+    step = jax.jit(engine.raw_step)           # VIOLATION: per-tick jit
+    return step(batch)

@@ -1,0 +1,21 @@
+"""Corpus false-positive guard: jit at module scope and jit cached in
+an engine-scope ``__init__`` are the repo's idiom — not violations."""
+
+import jax
+
+
+def _raw_step(x):
+    return x
+
+
+_step_jit = jax.jit(_raw_step)                # module scope: fine
+
+
+class Engine:
+    def __init__(self):
+        self._decode_jit = jax.jit(_raw_step)  # engine scope: fine
+
+
+# analysis: hot-seam
+def decode_tick(engine, batch):
+    return engine._decode_jit(batch)           # cached handle: fine

@@ -1,0 +1,10 @@
+"""Corpus: kernel-dma-balance fires exactly once — a kernel-shaped
+function starts an async copy and returns without waiting it (the
+landing buffer may be read before the DMA lands)."""
+
+
+# analysis: pallas-kernel
+def leaky_kernel(x_hbm, o_ref, buf, sem, pltpu):
+    cp = pltpu.make_async_copy(x_hbm, buf, sem)
+    cp.start()                                 # VIOLATION: never waited
+    o_ref[...] = buf[...]

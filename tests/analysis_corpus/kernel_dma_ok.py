@@ -1,0 +1,33 @@
+"""Corpus false-positive guard: both repo DMA disciplines are clean —
+the descriptor-recreation double buffer (flash-decode) and the
+started/waited list (_Ring.exchange)."""
+
+
+# analysis: pallas-kernel
+def double_buffered(x_hbm, o_ref, buf, sem, pl, pltpu, n_k):
+    def dma(src, slot, ki):
+        return pltpu.make_async_copy(src.at[ki], buf.at[slot], sem.at[slot])
+
+    dma(x_hbm, 0, 0).start()
+
+    def body(ki, acc):
+        slot = ki % 2
+
+        @pl.when(ki + 1 < n_k)
+        def _prefetch():
+            dma(x_hbm, 1 - slot, ki + 1).start()
+
+        dma(x_hbm, slot, ki).wait()
+        return acc + buf[slot].sum()
+
+    o_ref[...] = body(0, 0.0)
+
+
+# analysis: pallas-kernel
+def list_discipline(sbuf, rbuf, ssem, rsem, pltpu):
+    rdmas = []
+    rdmas.append(pltpu.make_async_remote_copy(sbuf, rbuf, ssem, rsem))
+    for r in rdmas:
+        r.start()
+    for r in rdmas:
+        r.wait()

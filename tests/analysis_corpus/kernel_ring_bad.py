@@ -1,0 +1,15 @@
+"""Corpus: kernel-ring-order fires exactly once — a forwarding ring
+kernel restages its send buffer AFTER consumed() released the landing
+slot: the left neighbor may reuse the slot while it is being read
+(the _ag_q8_kernel ordering contract, violated)."""
+
+
+# analysis: pallas-kernel
+def forwarding_ring(ring, send_q, o_ref, p):
+    ring.barrier()
+    for s in range(p - 1):
+        (incoming,) = ring.exchange(s, (None,))
+        o_ref[...] = incoming
+        ring.consumed(s)
+        send_q[...] = incoming               # VIOLATION: restage after release
+    ring.drain(p - 1)

@@ -1,0 +1,13 @@
+"""Corpus: thread-bind fires exactly once — a helper thread sending
+compat traffic without bind_thread is attributed to whatever rank last
+ran on that thread (the elastic-heartbeat bug class)."""
+
+import threading
+
+
+def start_heartbeat(rank, comm, mpiT, np):
+    def _beat():
+        mpiT.Send(np.asarray([rank]), dest=0, tag=7, comm=comm)
+
+    t = threading.Thread(target=_beat, daemon=True)  # VIOLATION
+    t.start()

@@ -1,0 +1,20 @@
+"""Corpus false-positive guards for thread-bind: a bound helper thread
+(the elastic heartbeat idiom), and a thread that never touches compat
+(the prefetch-worker idiom)."""
+
+import threading
+
+
+def start_heartbeat(rank, comm, mpiT, np):
+    def _beat():
+        mpiT.bind_thread(rank, comm)
+        mpiT.Send(np.asarray([rank]), dest=0, tag=7, comm=comm)
+
+    threading.Thread(target=_beat, daemon=True).start()  # bound: fine
+
+
+def start_prefetch(queue, fetch):
+    def _work():
+        queue.put(fetch())
+
+    threading.Thread(target=_work, daemon=True).start()  # no compat: fine

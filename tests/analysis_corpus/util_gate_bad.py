@@ -1,0 +1,9 @@
+"""Corpus: unlabeled-utilization fires exactly once — an MFU
+percentage computed with no platform gate anywhere in the function is
+a fabricated number on every non-TPU backend (the obs honesty rule)."""
+
+
+def rollup(flops, seconds, peak):
+    out = {"achieved_flops": flops / seconds}
+    out["mfu_pct"] = 100.0 * flops / (seconds * peak)  # VIOLATION
+    return out

@@ -1,0 +1,11 @@
+"""Corpus false-positive guard: the repo's real idiom — utilization
+percentages written only after a platform gate in the same function
+(obs/roofline.py's early return)."""
+
+
+def rollup(flops, seconds, peak, platform):
+    out = {"achieved_flops": flops / seconds, "platform": platform}
+    if platform != "tpu" or seconds <= 0:
+        return out
+    out["mfu_pct"] = 100.0 * flops / (seconds * peak)  # gated: fine
+    return out

@@ -115,6 +115,41 @@ def pytest_sessionfinish(session, exitstatus):
         session.exitstatus = 1
 
 
+# ---------------------------------------------------------------------------
+# Lock-order auditor (ISSUE 14 satellite): the threaded suites run with
+# mpit_tpu.analysis.lockdep enabled — every lock created by package code
+# is recorded, and a test whose run produces a cycle in the lock-order
+# graph (two locks ever taken in both orders = a latent deadlock,
+# whether or not this run interleaved into it) FAILS with the cycle
+# named. Scoped to the suites that actually exercise the host
+# concurrency layer; everything else pays nothing.
+# ---------------------------------------------------------------------------
+
+_LOCKDEP_SUITES = {"test_compat.py", "test_elastic.py"}
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_threaded_suites(request):
+    if os.path.basename(str(request.node.fspath)) not in _LOCKDEP_SUITES:
+        yield
+        return
+    from mpit_tpu.analysis import lockdep
+
+    lockdep.install()
+    lockdep.reset()
+    try:
+        yield
+        cycles = lockdep.cycles()
+        if cycles:
+            pytest.fail(
+                "lock-order cycle recorded during this test "
+                "(latent deadlock):\n" + lockdep.format_cycles(cycles)
+            )
+    finally:
+        lockdep.reset()
+        lockdep.uninstall()
+
+
 @pytest.fixture(scope="session")
 def n_devices() -> int:
     return jax.device_count()

@@ -460,29 +460,12 @@ class TestLMHeadSample:
         assert not _avals_with_shape(jx.jaxpr, (S, V))
 
 
-def _avals_with_shape(jaxpr, shape):
-    """Recursively collect eqn output avals of ``shape`` (incl. nested
-    call/scan/cond jaxprs) — the materialization detector."""
-    found = []
-    for eqn in jaxpr.eqns:
-        for var in eqn.outvars:
-            aval = getattr(var, "aval", None)
-            if aval is not None and getattr(aval, "shape", None) == shape:
-                found.append((eqn.primitive.name, aval))
-        for p in eqn.params.values():
-            for sub in _sub_jaxprs(p):
-                found.extend(_avals_with_shape(sub, shape))
-    return found
-
-
-def _sub_jaxprs(p):
-    if hasattr(p, "jaxpr"):
-        yield p.jaxpr
-    elif hasattr(p, "eqns"):
-        yield p
-    elif isinstance(p, (list, tuple)):
-        for q in p:
-            yield from _sub_jaxprs(q)
+# The materialization detector now lives in mpit_tpu.analysis (ISSUE
+# 14 satellite): ONE audited implementation shared by these pins, the
+# serve pins and the analyzer's whole-package contract sweep. Same
+# semantics as the old private helper (recursive over nested
+# call/scan/cond jaxprs, returns [(primitive_name, aval), ...]).
+from mpit_tpu.analysis.jaxpr_check import find_avals as _avals_with_shape  # noqa: E402
 
 
 @pytest.mark.slow

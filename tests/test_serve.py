@@ -438,7 +438,7 @@ class TestFlashDecodeServing:
         the real 50257 vocab the defaults (8192/128) are already sub-
         vocab."""
         _, params = model_and_params
-        from tests.test_decode_attention import _avals_with_shape
+        from mpit_tpu.analysis.jaxpr_check import find_avals as _avals_with_shape
 
         slots, max_len = 2, 32
         engine = Engine(
@@ -955,7 +955,7 @@ class TestPagedServing:
         kernel decode step has no [slots, vocab] f32 and no dense
         [slots, H, 1, max_len] score tensor."""
         _, params = model_and_params
-        from tests.test_decode_attention import _avals_with_shape
+        from mpit_tpu.analysis.jaxpr_check import find_avals as _avals_with_shape
 
         slots = 2
         # sample_block/k_cap forced below the tiny test vocab so the

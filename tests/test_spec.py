@@ -321,6 +321,73 @@ class TestExactSampling:
         np.testing.assert_array_equal(np.asarray(p_b), np.asarray(p_o))
         np.testing.assert_array_equal(np.asarray(r_b), np.asarray(r_o))
 
+    def test_proposal_q_is_exactly_the_engine_sampling_distribution(self):
+        """The rejection-sampling exactness precondition, pinned
+        structurally AND behaviorally (review finding: the proposal's
+        top-k/temperature math used to be a copy of sample_tokens'):
+        both now read ONE ``modified_logits`` implementation, and a
+        categorical draw from ``draft_distribution``'s scaled logits
+        reproduces ``sample_tokens`` bit-for-bit on sampled rows."""
+        from mpit_tpu.serve.engine import sample_tokens
+        from mpit_tpu.serve.spec import draft_distribution, modified_logits
+
+        kr = jax.random.key(5)
+        logits = jax.random.normal(kr, (6, 64), jnp.float32) * 3.0
+        temp = jnp.asarray([0.3, 0.7, 1.0, 1.3, 2.0, 0.9], jnp.float32)
+        topk = jnp.asarray([0, 4, 1, 8, 3, 63], jnp.int32)
+        probs, scaled = draft_distribution(logits, temp, topk)
+        np.testing.assert_array_equal(
+            np.asarray(scaled),
+            np.asarray(modified_logits(logits, temp, topk)),
+        )
+        key = jax.random.fold_in(kr, 1)
+        drawn = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+        np.testing.assert_array_equal(
+            np.asarray(drawn),
+            np.asarray(sample_tokens(logits, key, temp, topk)),
+        )
+        # And q really is the softmax of what the engine draws from.
+        np.testing.assert_allclose(
+            np.asarray(probs), np.asarray(jax.nn.softmax(scaled, axis=-1))
+        )
+
+    def test_verify_never_materializes_full_logits(self):
+        """The speculative verifier's jaxpr pin, on the shared
+        ``mpit_tpu.analysis.jaxpr_check`` API (ISSUE 14 satellite —
+        the serve/decode pins' one audited implementation): with
+        ``block_size < vocab`` no full-width ``[N, vocab]`` logits
+        matmul runs (qprobs legitimately ENTERS at [N, vocab], so the
+        pin is on dot_general outputs), and the one-block trace the
+        bitwise oracle test uses DOES produce it — non-vacuous."""
+        from mpit_tpu.analysis.jaxpr_check import (
+            assert_no_intermediate,
+            find_avals,
+        )
+        from mpit_tpu.ops.lm_head import lm_head_verify
+
+        n, d, v = 6, 16, 64
+        h = jnp.zeros((n, d), jnp.float32)
+        head = jnp.zeros((v, d), jnp.float32)
+        q = jnp.zeros((n, v), jnp.float32)
+        drafted = jnp.zeros((n,), jnp.int32)
+        temp = jnp.ones((n,), jnp.float32)
+        topk = jnp.zeros((n,), jnp.int32)
+
+        def trace(block):
+            return jax.make_jaxpr(
+                lambda h, w, q: lm_head_verify(
+                    h, w, drafted, q, jax.random.key(0), temp, topk,
+                    block_size=block, k_cap=8,
+                )
+            )(h, head, q)
+
+        assert_no_intermediate(
+            trace(16), (n, v), what="blocked lm_head_verify",
+            prims={"dot_general"},
+        )
+        # Anti-vacuity: at one vocab block the full-width matmul runs.
+        assert find_avals(trace(v), (n, v), prims={"dot_general"})
+
     def test_emitted_marginal_is_target_distribution(self):
         """The rejection-sampling exactness theorem, measured: drafted
         ~ q, accept u·q(x) < p(x), else residual — the emitted token's
