@@ -80,43 +80,53 @@ def sub_jaxprs(p):
             yield from sub_jaxprs(q)
 
 
-def find_avals(jaxpr, shape, prims=None):
+def find_avals(jaxpr, shape, prims=None, dtype=None):
     """Recursively collect eqn output avals of ``shape`` (incl. nested
     call/scan/cond jaxprs) — the materialization detector. Returns
     ``[(primitive_name, aval), ...]`` (the old test helpers' shape).
     ``prims`` optionally restricts to outputs of those primitives
     (e.g. ``{"dot_general"}`` pins "the logits matmul never runs at
     full width" while tolerating a full-width INPUT flowing through
-    elementwise ops)."""
+    elementwise ops). ``dtype`` optionally restricts by element type —
+    the quantized-decode contract (ISSUE 15) needs it: the int8 pool
+    ITSELF legitimately has the pool shape, and only a float32 aval of
+    that shape means the dequant escaped its tile."""
     jaxpr = _as_jaxpr(jaxpr)
     found = []
     for eqn in jaxpr.eqns:
         for var in eqn.outvars:
             aval = getattr(var, "aval", None)
             if aval is not None and getattr(aval, "shape", None) == shape:
+                if dtype is not None and getattr(
+                    aval, "dtype", None
+                ) != dtype:
+                    continue
                 if prims is None or eqn.primitive.name in prims:
                     found.append((eqn.primitive.name, aval))
         for p in eqn.params.values():
             for sub in sub_jaxprs(p):
-                found.extend(find_avals(sub, shape, prims))
+                found.extend(find_avals(sub, shape, prims, dtype))
     return found
 
 
-def assert_no_intermediate(jaxpr, *shapes, what="step", prims=None):
-    """No eqn output of any of ``shapes`` anywhere in the jaxpr."""
+def assert_no_intermediate(jaxpr, *shapes, what="step", prims=None,
+                           dtype=None):
+    """No eqn output of any of ``shapes`` (of ``dtype``, when given)
+    anywhere in the jaxpr."""
     for shape in shapes:
-        hits = find_avals(jaxpr, tuple(shape), prims)
+        hits = find_avals(jaxpr, tuple(shape), prims, dtype)
         if hits:
             raise JaxprContractError(
-                f"{what} materializes {tuple(shape)}: "
+                f"{what} materializes {tuple(shape)}"
+                f"{f' ({dtype})' if dtype is not None else ''}: "
                 f"{[(p, str(a)) for p, a in hits[:4]]}"
             )
 
 
-def assert_intermediate(jaxpr, shape, what="reference"):
+def assert_intermediate(jaxpr, shape, what="reference", dtype=None):
     """Anti-vacuity: the shape IS produced somewhere (so the matching
     ``assert_no_intermediate`` on the optimized path means something)."""
-    if not find_avals(jaxpr, tuple(shape)):
+    if not find_avals(jaxpr, tuple(shape), None, dtype):
         raise JaxprContractError(
             f"{what} no longer materializes {tuple(shape)} — the "
             "no-materialization pin on the optimized path is vacuous"
@@ -290,6 +300,85 @@ def _contract_paged_decode_blocked(ctx):
     assert_no_transfer(jx, what="paged decode step")
 
 
+def _contract_quantized_decode(ctx):
+    """ISSUE 15: the int8 KV cache's dequant stays PER-TILE inside the
+    decode kernel — no full dequantized f32 pool (or per-slot dense
+    view) intermediate may materialize in the quantized decode step's
+    jaxpr. The int8 pool itself legitimately carries the pool shape, so
+    the pin is dtype-filtered to float32. Anti-vacuity: the reference
+    engine (the parity oracle) DOES materialize the dequantized f32
+    view — the pin means something."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpit_tpu.serve import Engine
+
+    cfg, params = ctx["model"]
+    slots, pages, ps = 2, 24, 8
+    eng = Engine(
+        cfg, params, slots=slots, max_len=40, prefill_len=8,
+        kv_pages=pages, kv_page_size=ps, decode_attention="interpret",
+        sample_block=32, sample_k_cap=16, kv_dtype="int8",
+    )
+    bt = jnp.zeros((slots, eng.pages_per_slot), jnp.int32)
+    jx = jax.make_jaxpr(eng._paged_decode_step)(
+        eng.params, eng.cache, eng.last_token,
+        jnp.ones((slots,), bool), bt, jax.random.key(0),
+        jnp.zeros((slots,), jnp.float32), jnp.zeros((slots,), jnp.int32),
+    )
+    f32 = jnp.dtype(jnp.float32)
+    pool = (pages, ps, cfg.num_heads, cfg.head_dim)
+    assert_no_intermediate(
+        jx,
+        pool,                              # one layer's dequantized pool
+        (cfg.num_layers,) + pool,          # the stacked pools
+        (slots, eng.pages_per_slot * ps,   # a slot's gathered dense view
+         cfg.num_heads, cfg.head_dim),
+        what="quantized paged decode step",
+        dtype=f32,
+    )
+    # The [slots, vocab] pin survives quantization too.
+    assert_no_intermediate(
+        jx, (slots, cfg.vocab_size), (slots, 1, cfg.vocab_size),
+        what="quantized paged decode step",
+    )
+    ref = Engine(
+        cfg, params, slots=slots, max_len=40, prefill_len=8,
+        kv_pages=pages, kv_page_size=ps, decode_attention="reference",
+        kv_dtype="int8",
+    )
+    jx_ref = jax.make_jaxpr(ref._paged_decode_step)(
+        ref.params, ref.cache, ref.last_token,
+        jnp.ones((slots,), bool), bt, jax.random.key(0),
+        jnp.zeros((slots,), jnp.float32), jnp.zeros((slots,), jnp.int32),
+    )
+    assert_intermediate(
+        jx_ref,
+        (slots, eng.pages_per_slot * ps, cfg.num_heads, cfg.head_dim),
+        what="quantized reference decode (dequant oracle)",
+        dtype=f32,
+    )
+    # Dense form: the quantized dense step never materializes the f32
+    # per-slot buffer either (its int8 buffer carries the shape).
+    dense = Engine(
+        cfg, params, slots=slots, max_len=32, prefill_len=8,
+        decode_attention="interpret", sample_block=32, sample_k_cap=16,
+        kv_dtype="int8",
+    )
+    jxd = jax.make_jaxpr(dense._decode_step)(
+        dense.params, dense.cache, dense.last_token,
+        jnp.ones((slots,), bool), jax.random.key(0),
+        jnp.zeros((slots,), jnp.float32), jnp.zeros((slots,), jnp.int32),
+    )
+    assert_no_intermediate(
+        jxd,
+        (slots, 32, cfg.num_heads, cfg.head_dim),
+        (cfg.num_layers, slots, 32, cfg.num_heads, cfg.head_dim),
+        what="quantized dense decode step",
+        dtype=f32,
+    )
+
+
 def _contract_lm_head_sample(ctx):
     """The blocked sampler never runs the full-width logits matmul."""
     import jax
@@ -377,6 +466,7 @@ def _contract_train_step_donation(ctx):
 CONTRACTS = {
     "decode-blocked": _contract_decode_blocked,
     "paged-decode-blocked": _contract_paged_decode_blocked,
+    "quantized-decode": _contract_quantized_decode,
     "lm-head-sample": _contract_lm_head_sample,
     "lm-head-verify": _contract_lm_head_verify,
     "train-step-donation": _contract_train_step_donation,

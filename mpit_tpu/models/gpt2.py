@@ -26,6 +26,13 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from mpit_tpu.ops.kv_quant import (
+    QuantizedKV,
+    dequantize_kv,
+    kv_stack,
+    quantize_kv,
+)
+
 AttentionFn = Callable[..., jax.Array]  # (q, k, v, *, causal) -> out
 
 
@@ -54,6 +61,11 @@ def cache_update(cache, new, lengths):
     overwritten one-by-one by later decode appends before any attention
     mask ever exposes them), decode with T = 1 at the slot's current
     length. Dynamic per-slot starts via a vmapped dynamic_update_slice.
+
+    A :class:`~mpit_tpu.ops.kv_quant.QuantizedKV` cache (ISSUE 15)
+    quantizes on write: the new rows go through the shared per-(row,
+    head) ``amax/127`` contract once, here, and the scale rows land at
+    the same per-slot positions as their int8 rows.
     """
 
     def write(c, n, start):
@@ -61,6 +73,12 @@ def cache_update(cache, new, lengths):
             c, n.astype(c.dtype), start, axis=0
         )
 
+    if isinstance(cache, QuantizedKV):
+        qn = quantize_kv(new)
+        return QuantizedKV(
+            q=jax.vmap(write)(cache.q, qn.q, lengths),
+            scale=jax.vmap(write)(cache.scale, qn.scale, lengths),
+        )
     return jax.vmap(write)(cache, new, lengths)
 
 
@@ -78,6 +96,12 @@ def paged_cache_update(pool, new, lengths, block_table, valid=None):
     DROPPED, so — unlike the dense path, where junk writes stayed
     inside the slot's own row — a padded prefill can never touch a
     page the slot does not own.
+
+    A :class:`~mpit_tpu.ops.kv_quant.QuantizedKV` pool (ISSUE 15)
+    quantizes on write and scatters the per-(row, head) scale blocks
+    through the SAME flat indices — the scale scatter rides the
+    existing block-table path, so COW/prefix/preemption semantics
+    cover scales by construction.
     """
     p, ps = pool.shape[0], pool.shape[1]
     b, t = new.shape[0], new.shape[1]
@@ -93,12 +117,21 @@ def paged_cache_update(pool, new, lengths, block_table, valid=None):
     flat = jnp.where(pos < block_table.shape[1] * ps, flat, p * ps)
     if valid is not None:
         flat = jnp.where(valid, flat, p * ps)  # OOB -> dropped
-    pool_flat = pool.reshape(p * ps, *pool.shape[2:])
-    pool_flat = pool_flat.at[flat.reshape(-1)].set(
-        new.astype(pool.dtype).reshape(b * t, *new.shape[2:]),
-        mode="drop",
-    )
-    return pool_flat.reshape(pool.shape)
+
+    def scatter(pl, rows):
+        pool_flat = pl.reshape(p * ps, *pl.shape[2:])
+        pool_flat = pool_flat.at[flat.reshape(-1)].set(
+            rows.astype(pl.dtype).reshape(b * t, *rows.shape[2:]),
+            mode="drop",
+        )
+        return pool_flat.reshape(pl.shape)
+
+    if isinstance(pool, QuantizedKV):
+        qn = quantize_kv(new)
+        return QuantizedKV(
+            q=scatter(pool.q, qn.q), scale=scatter(pool.scale, qn.scale)
+        )
+    return scatter(pool, new)
 
 
 def paged_gather(pool, block_table):
@@ -106,9 +139,14 @@ def paged_gather(pool, block_table):
     [P, page_size, H, Dh] gathered through [B, pages_per_slot] →
     [B, pages_per_slot·page_size, H, Dh]. Rows past a slot's fill are
     whatever the mapped (or stale) pages hold — garbage by design; the
-    attention mask defines validity, exactly as in the dense cache."""
-    g = pool[block_table]  # [B, n_ps, ps, H, Dh]
-    return g.reshape(g.shape[0], -1, *g.shape[3:])
+    attention mask defines validity, exactly as in the dense cache. A
+    quantized pool gathers q and scale together (tree-mapped)."""
+
+    def g1(pl):
+        g = pl[block_table]  # [B, n_ps, ps, H, Dh]
+        return g.reshape(g.shape[0], -1, *g.shape[3:])
+
+    return jax.tree.map(g1, pool)
 
 
 def paged_cached_attention(q, k_pool, v_pool, lengths, block_table):
@@ -141,7 +179,17 @@ def cached_attention(q, k, v, lengths):
     cached and uncached forwards agree numerically (masked keys
     contribute exact zeros). Heads-local by construction: the TP engine
     calls this on its H/P head shard unchanged.
+
+    Quantized buffers (ISSUE 15) dequantize here through the shared
+    per-(row, head) helpers — this dense view is the flash kernel's
+    numerical oracle AND the off-TPU fallback, so tier-1 exercises the
+    exact per-tile dequant math on CPU (the PR 9 oracle pattern). The
+    serving kernel never materializes it: int8 tiles + scale blocks are
+    what cross HBM→VMEM there.
     """
+    if isinstance(k, QuantizedKV):
+        k = dequantize_kv(k)
+        v = dequantize_kv(v)
     dh = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(dh)
     t_q, s_max = q.shape[1], k.shape[1]
@@ -395,7 +443,7 @@ class GPT2(nn.Module):
                 x = block(cfg, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln_f")(x)
         if return_hidden:
-            return x, (jnp.stack(new_k), jnp.stack(new_v))
+            return x, (kv_stack(new_k), kv_stack(new_v))
         # LM head (f32 accumulation regardless of operand dtype); tied to
         # wte by default, separate under tie_head=False (see GPT2Config).
         head = (
@@ -421,7 +469,7 @@ class GPT2(nn.Module):
             preferred_element_type=jnp.float32,
         )
         if cache is not None or paged_cache is not None:
-            return logits, (jnp.stack(new_k), jnp.stack(new_v))
+            return logits, (kv_stack(new_k), kv_stack(new_v))
         return logits
 
     @staticmethod

@@ -44,13 +44,21 @@ from mpit_tpu.ops.flash_attention import (
     merge_attention,
     reference_attention,
 )
+from mpit_tpu.ops.kv_quant import (
+    QuantizedKV,
+    dequantize_kv,
+    kv_wire_bytes_per_row,
+    quantize_kv,
+)
 from mpit_tpu.ops.lm_head import lm_head_sample, lm_head_xent
 from mpit_tpu.ops.ring_allreduce import ring_allreduce
 from mpit_tpu.ops.ring_collectives import (
     RingPlan,
+    dequantize_blocks,
     dequantize_chunk,
     plan_ring,
     plan_shards,
+    quantize_blocks,
     quantize_chunk,
     ring_all_gather,
     ring_reduce_scatter,
@@ -68,10 +76,16 @@ __all__ = [
     "lm_head_xent",
     "ring_allreduce",
     "RingPlan",
+    "QuantizedKV",
+    "dequantize_blocks",
     "dequantize_chunk",
+    "dequantize_kv",
+    "kv_wire_bytes_per_row",
     "plan_ring",
     "plan_shards",
+    "quantize_blocks",
     "quantize_chunk",
+    "quantize_kv",
     "ring_all_gather",
     "ring_reduce_scatter",
 ]

@@ -51,6 +51,21 @@ page_size`` — so the tile loop indirects through the table with zero
 extra HBM traffic (``page_size`` must be a multiple of ``block_k``:
 a tile never straddles pages). Skipped tiles still cost neither FLOPs
 nor HBM reads, and the heads-local/TP calling convention is unchanged.
+
+**Quantized variant (ISSUE 15).** Passing
+:class:`~mpit_tpu.ops.kv_quant.QuantizedKV` buffers (int8 payload +
+per-(row, head) f32 scales) selects the FUSED-DEQUANT form of the same
+kernel: what crosses HBM→VMEM per visited tile is the int8 K/V tile
+plus its ``[block_k, H]`` scale block (two extra DMA channels on the
+same double buffer), and the dequant
+(:func:`~mpit_tpu.ops.ring_collectives.dequantize_blocks` — the PR 9
+rounding contract's inverse) runs in VMEM per tile, per head. The f32
+online-softmax m/l/acc structure, the visibility mask, tile skipping
+and the in-kernel visited count are byte-for-byte the unquantized
+loop's; a full dequantized f32 buffer NEVER materializes on this path
+(contract-checked by ``mpit_tpu.analysis``). The off-TPU fallback
+dequantizes through the same helpers inside the reference math — the
+kernel's numerical oracle, so tier-1 pins the per-tile dequant on CPU.
 """
 
 from __future__ import annotations
@@ -62,6 +77,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from mpit_tpu.ops.kv_quant import QuantizedKV
+from mpit_tpu.ops.ring_collectives import dequantize_blocks
 
 __all__ = [
     "flash_decode_attention",
@@ -152,8 +170,9 @@ def _decode_kernel(
     head_dim,
     scale,
     page_size=None,
+    quantized=False,
 ):
-    """Flash-decode body, dense or paged.
+    """Flash-decode body, dense or paged, plain or fused-dequant.
 
     Dense (``page_size=None``) refs: ``lengths_ref`` [B] int32 SMEM,
     ``q_ref`` [1, T, H·D] VMEM, ``k_hbm``/``v_hbm`` [B, S, H·D]
@@ -163,15 +182,36 @@ def _decode_kernel(
     other difference is the DMA source: tile ``ki`` is resolved through
     the block table instead of being a contiguous row slice. The flash
     loop, masks and accumulators are byte-for-byte the same code.
+
+    ``quantized`` (ISSUE 15): the HBM operand list interleaves scale
+    planes — ``k, k_scale, v, v_scale`` with scales [B, S, H] (dense)
+    or [num_pages, page_size, H] (paged) f32 — and the scratch grows
+    matching [2, block_k, H] double buffers on two extra DMA channels.
+    Each visited tile dequantizes in VMEM, per head, through the shared
+    :func:`~mpit_tpu.ops.ring_collectives.dequantize_blocks`; the rest
+    of the loop is identical, in f32 operands.
     """
+    refs = list(refs)
+    lengths_ref = refs.pop(0)
+    bt_ref = refs.pop(0) if page_size is not None else None
+    q_ref = refs.pop(0)
+    if quantized:
+        k_hbm, ks_hbm, v_hbm, vs_hbm = refs[:4]
+        del refs[:4]
+    else:
+        k_hbm, v_hbm = refs[:2]
+        del refs[:2]
+        ks_hbm = vs_hbm = None
+    o_ref, visited_ref = refs[:2]
+    del refs[:2]
+    if quantized:
+        k_buf, ks_buf, v_buf, vs_buf, sem = refs
+    else:
+        (k_buf, v_buf, sem) = refs
+        ks_buf = vs_buf = None
     if page_size is None:
-        (lengths_ref, q_ref, k_hbm, v_hbm, o_ref, visited_ref,
-         k_buf, v_buf, sem) = refs
-        bt_ref = None
         s = k_hbm.shape[1]
     else:
-        (lengths_ref, bt_ref, q_ref, k_hbm, v_hbm, o_ref, visited_ref,
-         k_buf, v_buf, sem) = refs
         s = bt_ref.shape[1] * page_size  # virtual per-slot cache length
     b = pl.program_id(0)
     t_q = q_ref.shape[1]
@@ -198,8 +238,14 @@ def _decode_kernel(
             src, which_buf.at[slot], sem.at[sem_row, slot]
         )
 
-    dma(k_hbm, k_buf, 0, 0, 0).start()
-    dma(v_hbm, v_buf, 1, 0, 0).start()
+    # The per-tile DMA channel set: K and V always; their scale planes
+    # ride two more channels of the same double buffer when quantized.
+    channels = [(k_hbm, k_buf, 0), (v_hbm, v_buf, 1)]
+    if quantized:
+        channels += [(ks_hbm, ks_buf, 2), (vs_hbm, vs_buf, 3)]
+
+    for hbm, buf, row in channels:
+        dma(hbm, buf, row, 0, 0).start()
 
     t_pos = length + lax.broadcasted_iota(jnp.int32, (t_q, block_k), 0)
 
@@ -208,11 +254,11 @@ def _decode_kernel(
 
         @pl.when(ki + 1 < n_k)
         def _prefetch():
-            dma(k_hbm, k_buf, 0, 1 - slot, ki + 1).start()
-            dma(v_hbm, v_buf, 1, 1 - slot, ki + 1).start()
+            for hbm, buf, row in channels:
+                dma(hbm, buf, row, 1 - slot, ki + 1).start()
 
-        dma(k_hbm, k_buf, 0, slot, ki).wait()
-        dma(v_hbm, v_buf, 1, slot, ki).wait()
+        for hbm, buf, row in channels:
+            dma(hbm, buf, row, slot, ki).wait()
 
         k_pos = ki * block_k + lax.broadcasted_iota(
             jnp.int32, (t_q, block_k), 1
@@ -227,6 +273,18 @@ def _decode_kernel(
             q = q_ref[0, :, h * d : (h + 1) * d]  # [T, d]
             k_blk = k_buf[slot, :, h * d : (h + 1) * d]  # [bk, d]
             v_blk = v_buf[slot, :, h * d : (h + 1) * d]
+            if quantized:
+                # Fused per-tile dequant (ISSUE 15): the int8 tile and
+                # its [bk, H] scale block are already in VMEM; the f32
+                # view exists only at tile size, per head — the shared
+                # PR 9 contract's inverse, operands f32 from here on.
+                k_blk = dequantize_blocks(
+                    k_blk, ks_buf[slot][:, h : h + 1]
+                )
+                v_blk = dequantize_blocks(
+                    v_blk, vs_buf[slot][:, h : h + 1]
+                )
+                q = q.astype(jnp.float32)
             sc = lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -269,18 +327,44 @@ def _vma(x):
     return getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
 
 
+def _kv_operands(k, v, h, pk):
+    """The kernel's HBM operand list + matching double-buffer scratch
+    for one (K, V) pair — plain buffers or the quantized interleave
+    ``k, k_scale, v, v_scale`` (scales packed [.., H] from the stored
+    keepdims [.., H, 1] form). One helper serves the dense and paged
+    calls, so the operand order and the kernel's unpacking cannot
+    drift apart."""
+    quantized = isinstance(k, QuantizedKV)
+    if not quantized:
+        return quantized, [pk(k), pk(v)], [k.dtype, v.dtype]
+    psc = lambda sc: sc.reshape(sc.shape[0], sc.shape[1], h)
+    ops = [pk(k.q), psc(k.scale), pk(v.q), psc(v.scale)]
+    return quantized, ops, [jnp.int8, jnp.float32, jnp.int8, jnp.float32]
+
+
+def _scratch_for(quantized, block_k, hd, h, dtypes):
+    """Double-buffer VMEM scratch matching :func:`_kv_operands`' order
+    (+ the DMA semaphore array sized to the channel count)."""
+    widths = [hd, h, hd, h] if quantized else [hd, hd]
+    bufs = [
+        pltpu.VMEM((2, block_k, w), dt) for w, dt in zip(widths, dtypes)
+    ]
+    return bufs + [pltpu.SemaphoreType.DMA((len(widths), 2))]
+
+
 @functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
 def _decode_call(q, k, v, lengths, *, block_k, interpret):
     b, t, h, d = q.shape
-    s = k.shape[1]
     hd = h * d
     pk = lambda x: x.reshape(x.shape[0], x.shape[1], hd)  # free head-pack
+    quantized, kv_ops, kv_dtypes = _kv_operands(k, v, h, pk)
     kern = functools.partial(
         _decode_kernel,
         block_k=block_k,
         num_heads=h,
         head_dim=d,
         scale=1.0 / (d ** 0.5),
+        quantized=quantized,
     )
     o, visited = pl.pallas_call(
         kern,
@@ -290,9 +374,10 @@ def _decode_call(q, k, v, lengths, *, block_k, interpret):
             pl.BlockSpec(
                 (1, t, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
             ),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # K stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),  # V stays in HBM
-        ],
+        ]
+        # K/V (+ scale planes when quantized) stay in HBM; the kernel
+        # DMAs visited tiles itself.
+        + [pl.BlockSpec(memory_space=pltpu.ANY) for _ in kv_ops],
         out_specs=[
             pl.BlockSpec(
                 (1, t, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
@@ -305,13 +390,9 @@ def _decode_call(q, k, v, lengths, *, block_k, interpret):
             jax.ShapeDtypeStruct((b, t, hd), q.dtype, vma=_vma(q)),
             jax.ShapeDtypeStruct((b, 1), jnp.int32, vma=_vma(q)),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((2, block_k, hd), k.dtype),
-            pltpu.VMEM((2, block_k, hd), v.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=_scratch_for(quantized, block_k, hd, h, kv_dtypes),
         interpret=bool(interpret),
-    )(jnp.asarray(lengths, jnp.int32), pk(q), pk(k), pk(v))
+    )(jnp.asarray(lengths, jnp.int32), pk(q), *kv_ops)
     return o.reshape(b, t, h, d), visited[:, 0]
 
 
@@ -325,6 +406,7 @@ def _paged_decode_call(
     b, t, h, d = q.shape
     hd = h * d
     pk = lambda x: x.reshape(x.shape[0], x.shape[1], hd)  # free head-pack
+    quantized, kv_ops, kv_dtypes = _kv_operands(k_pool, v_pool, h, pk)
     kern = functools.partial(
         _decode_kernel,
         block_k=block_k,
@@ -332,6 +414,7 @@ def _paged_decode_call(
         head_dim=d,
         scale=1.0 / (d ** 0.5),
         page_size=page_size,
+        quantized=quantized,
     )
     o, visited = pl.pallas_call(
         kern,
@@ -342,9 +425,9 @@ def _paged_decode_call(
             pl.BlockSpec(
                 (1, t, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
             ),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # K pool stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),  # V pool stays in HBM
-        ],
+        ]
+        # K/V pools (+ scale planes when quantized) stay in HBM.
+        + [pl.BlockSpec(memory_space=pltpu.ANY) for _ in kv_ops],
         out_specs=[
             pl.BlockSpec(
                 (1, t, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
@@ -357,16 +440,12 @@ def _paged_decode_call(
             jax.ShapeDtypeStruct((b, t, hd), q.dtype, vma=_vma(q)),
             jax.ShapeDtypeStruct((b, 1), jnp.int32, vma=_vma(q)),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((2, block_k, hd), k_pool.dtype),
-            pltpu.VMEM((2, block_k, hd), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=_scratch_for(quantized, block_k, hd, h, kv_dtypes),
         interpret=bool(interpret),
     )(
         jnp.asarray(lengths, jnp.int32),
         jnp.asarray(block_table, jnp.int32),
-        pk(q), pk(k_pool), pk(v_pool),
+        pk(q), *kv_ops,
     )
     return o.reshape(b, t, h, d), visited[:, 0]
 

@@ -203,23 +203,55 @@ def _pad_1d(x, total):
 # ---------------------------------------------------------------------------
 
 
+def _q8_scale(amax):
+    """The ONE scale rule: ``amax/127`` (1.0 for an all-zero block so
+    dequant stays exact). Shared by the scalar chunk form (ring wire)
+    and the blocked form (KV cache) — one rounding contract repo-wide."""
+    return jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+
+
+def _q8_round(x, scale):
+    """The ONE rounding rule: round-half-to-even (deterministic — the
+    loss-curve / greedy-stability pins are the reproducibility
+    contract, so no stochastic rounding), clip to ±127."""
+    return jnp.clip(jnp.round(x / scale), -127.0, 127.0).astype(jnp.int8)
+
+
 def quantize_chunk(x):
-    """Symmetric per-chunk int8: ``scale = amax/127`` (1.0 for an
-    all-zero chunk so dequant stays exact), round-half-to-even
-    (deterministic — the loss-curve pin is the reproducibility
-    contract, so no stochastic rounding), clip to ±127.
+    """Symmetric per-chunk int8: one scalar ``scale = amax/127`` over
+    the whole chunk (:func:`_q8_scale`), round-half-to-even clip to
+    ±127 (:func:`_q8_round`).
 
     Returns ``(q int8, scale f32 scalar)``; round-trip error is bounded
     by ``scale/2`` per element (pinned in tests)."""
     x = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(x))
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
-    q = jnp.clip(jnp.round(x / scale), -127.0, 127.0).astype(jnp.int8)
-    return q, scale
+    scale = _q8_scale(jnp.max(jnp.abs(x)))
+    return _q8_round(x, scale), scale
 
 
 def dequantize_chunk(q, scale):
     """Inverse of :func:`quantize_chunk` (f32 result)."""
+    return q.astype(jnp.float32) * scale
+
+
+def quantize_blocks(x, axis=-1):
+    """Blocked form of :func:`quantize_chunk`: one scale per slice
+    along ``axis`` (every other axis indexes an independent block) —
+    the quantized KV cache's per-(row, head) grain (ISSUE 15). Same
+    scale rule, same round-half-to-even, same ±127 clip, via the same
+    shared helpers; only the amax reduction axis differs.
+
+    Returns ``(q int8 like x, scale f32 with axis kept at size 1)`` —
+    keepdims so the scale broadcasts back over its block for dequant
+    and rides pytrees next to ``q`` at equal rank."""
+    x = x.astype(jnp.float32)
+    scale = _q8_scale(jnp.max(jnp.abs(x), axis=axis, keepdims=True))
+    return _q8_round(x, scale), scale
+
+
+def dequantize_blocks(q, scale):
+    """Inverse of :func:`quantize_blocks` (f32 result; ``scale``
+    broadcasts — keepdims form or any compatible shape)."""
     return q.astype(jnp.float32) * scale
 
 

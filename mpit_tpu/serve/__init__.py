@@ -67,9 +67,11 @@ from mpit_tpu.serve.kvcache import (
     KVCache,
     PageAllocator,
     PagedKVCache,
+    QuantizedKV,
     alloc_cache,
     alloc_paged_cache,
     cache_specs,
+    kv_wire_bytes_per_row,
     paged_cache_specs,
     pages_needed,
 )
@@ -103,6 +105,7 @@ __all__ = [
     "PageAllocator",
     "PagedKVCache",
     "PolicyConfig",
+    "QuantizedKV",
     "Request",
     "RequestClass",
     "SchedulingPolicy",
@@ -118,6 +121,7 @@ __all__ = [
     "expected_param_shapes",
     "generate_arrivals",
     "infer_config",
+    "kv_wire_bytes_per_row",
     "load_gpt2_params",
     "parse_load_spec",
     "sample_tokens",

@@ -28,6 +28,14 @@ decode ticks; the live stats line grows ``kv=`` (pool occupancy),
 ``kvtok=`` (tokens cached) and ``shr=`` (pages stored once, mapped by
 several requests).
 
+``--kv-dtype int8`` (ISSUE 15) quantizes the KV cache: int8 rows +
+per-(row, head) scale blocks in HBM, dequantized per visited tile
+inside the decode kernel — the dominant decode HBM sweep shrinks ~2×
+vs bf16 and the same pool budget holds ~2× the tokens. The stats line
+shows the wire dtype (``kvd=``); ``--kv-dtype f32|bf16`` simply pin
+the dense cache dtype. Rejected with ``--decode-attention reference``
+(the oracle path dequantizes the whole cache per tick).
+
 Roofline flight data (ISSUE 8): the engine's jitted steps register
 their ``cost_analysis()`` costs at warm, every decode tick feeds the
 length-aware achieved HBM bytes (visited-tile model) into the recorder
@@ -103,6 +111,16 @@ class ServeConfig:
     kv_pages: int = 0
     kv_page_size: int = 16
     prefill_chunk: int = 0
+    # KV cache wire dtype (ISSUE 15). "" = the model dtype (default
+    # path, byte-identical); f32|bf16 pin the cache dtype; int8 stores
+    # quantized rows + per-(row, head) scales and fuses the dequant
+    # into the decode kernel's per-tile DMA loop — ~2x fewer decode
+    # HBM bytes than bf16, ~2x tokens at the same pool budget.
+    # Rejected with --decode-attention reference: the dense reference
+    # path dequantizes the WHOLE cache per tick (it exists as the
+    # parity oracle, not a serving path — the perf the flag buys needs
+    # the fused per-tile dequant of kernel/interpret).
+    kv_dtype: str = ""
     # Speculative decoding (ISSUE 13). spec_k > 0 swaps the decode tick
     # for draft-then-verify (k drafted tokens per slot, one T=k+1 target
     # verify, longest-prefix acceptance with cache rollback). The draft
@@ -233,6 +251,23 @@ def _build_engine(cfg: ServeConfig):
         raise SystemExit(
             "--draft-ckpt/--draft-config require --spec-k >= 1"
         )
+    if cfg.kv_dtype and cfg.kv_dtype not in ("f32", "bf16", "int8"):
+        raise SystemExit(
+            f"--kv-dtype {cfg.kv_dtype!r}: expected f32, bf16 or int8"
+        )
+    if cfg.kv_dtype == "int8" and cfg.decode_attention == "reference":
+        # Precise submit-time rejection (ISSUE 15 satellite): the dense
+        # reference engine HAS the dequant hooks (it is the parity
+        # oracle) but dequantizes the whole cache every tick — serving
+        # int8 through it pays quantization error for MORE bytes moved,
+        # the opposite of what the flag promises.
+        raise SystemExit(
+            "--kv-dtype int8 with --decode-attention reference: the "
+            "reference path materializes the full dequantized cache "
+            "per tick (it is the parity oracle, not a serving path); "
+            "use --decode-attention kernel (or interpret) for the "
+            "fused per-tile dequant"
+        )
     engine = Engine(
         mcfg,
         params,
@@ -253,6 +288,7 @@ def _build_engine(cfg: ServeConfig):
         spec_k=cfg.spec_k,
         draft_params=draft_params,
         draft_cfg=draft_cfg,
+        kv_dtype=cfg.kv_dtype or None,
     )
     return engine, mcfg
 
@@ -310,6 +346,11 @@ def _live_line(registry, monitor, server, now: float) -> str:
     )
     if server.policy is not None:
         line += f" pre={server.policy.preemptions}"
+    if getattr(server.engine, "kv_dtype_explicit", False):
+        # The cache WIRE dtype (ISSUE 15): what the decode sweep
+        # actually moves — shown whenever it was explicitly chosen, so
+        # an int8 run's hbmbw= figure is attributable from the line.
+        line += f" kvd={server.engine.kv_dtype}"
     if "kv_pool_occupancy" in g:
         # Cache-MEMORY efficiency next to slot occupancy (ISSUE 7):
         # pool fill, tokens actually held, pages stored once but

@@ -79,6 +79,7 @@ from mpit_tpu.models.gpt2 import (
     paged_cache_update,
     paged_cached_attention,
 )
+from mpit_tpu.ops.kv_quant import kv_stack
 from mpit_tpu.obs import roofline as _roofline
 from mpit_tpu.ops.decode_attention import (
     flash_decode_attention,
@@ -100,10 +101,29 @@ from mpit_tpu.serve.kvcache import (
     alloc_cache,
     alloc_paged_cache,
     cache_specs,
+    kv_wire_bytes_per_row,
     paged_cache_specs,
 )
 
 __all__ = ["Engine", "sample_tokens"]
+
+# Engine.kv_dtype values (None = follow cfg.dtype — the default path,
+# byte-identical to an engine that never heard of the knob). "int8"
+# (ISSUE 15) stores the cache as int8 + per-(row, head) scale blocks:
+# writes quantize through the shared ring-collectives rounding
+# contract, the flash-decode kernel dequantizes per visited tile in
+# VMEM, and the reference path dequantizes through the same helpers
+# (the oracle). "f32"/"bf16" simply pin the dense cache dtype.
+_KV_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16, "int8": None}
+_DTYPE_SHORT = {"float32": "f32", "bfloat16": "bf16", "int8": "int8"}
+
+
+def _kv_where(mask, new, old):
+    """Per-slot select over a K (or V) buffer — one ``jnp.where`` on a
+    plain array, the same where over int8 payload AND scale blocks on a
+    quantized buffer (equal rank by construction, so one broadcast mask
+    serves both leaves)."""
+    return jax.tree.map(lambda a, b: jnp.where(mask, a, b), new, old)
 
 # Engine.decode_attention values. "kernel" = the Pallas flash-decode path
 # (ISSUE 5) where available — on non-TPU backends the kernel call falls
@@ -248,7 +268,7 @@ def _tp_cache_forward(
         layer_kv=layer_kv, with_head=with_head,
     )
     return out, KVCache(
-        k=jnp.stack(new_k), v=jnp.stack(new_v), lengths=cache.lengths
+        k=kv_stack(new_k), v=kv_stack(new_v), lengths=cache.lengths
     )
 
 
@@ -283,7 +303,7 @@ def _tp_paged_forward(
         layer_kv=layer_kv, with_head=with_head, clip_positions=True,
     )
     return out, PagedKVCache(
-        k=jnp.stack(new_k), v=jnp.stack(new_v), lengths=cache.lengths
+        k=kv_stack(new_k), v=kv_stack(new_v), lengths=cache.lengths
     )
 
 
@@ -349,6 +369,7 @@ class Engine:
         spec_k: int = 0,
         draft_params=None,
         draft_cfg: GPT2Config | None = None,
+        kv_dtype: str | None = None,
     ):
         if decode_attention not in _DECODE_MODES:
             raise ValueError(
@@ -361,6 +382,33 @@ class Engine:
         self.prefill_len = min(prefill_len or self.max_len, self.max_len)
         self.tp_axis = tp_axis
         self._key = jax.random.key(seed)
+
+        # -- KV cache wire dtype (ISSUE 15 tentpole) --------------------------
+        # None = the historical default (cache in cfg.dtype) — the path
+        # stays byte-identical, pinned by the greedy-parity suite.
+        # "int8" = quantized storage + in-kernel fused dequant; the
+        # engine's whole step surface (dense/paged/TP/chunked/spec)
+        # carries the dtype, still at the pinned lifetime compile count.
+        if kv_dtype is not None and kv_dtype not in _KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of "
+                f"{sorted(k for k in _KV_DTYPES)} (or None = follow the "
+                f"model dtype), got {kv_dtype!r}"
+            )
+        self.kv_quantized = kv_dtype == "int8"
+        self._cache_dtype = (
+            _KV_DTYPES[kv_dtype]
+            if kv_dtype is not None and not self.kv_quantized
+            else None  # None = follow cfg.dtype (alloc default)
+        )
+        # The wire dtype label (stats / span stamping / bench): what the
+        # cache rows actually occupy HBM as. kv_dtype_explicit gates the
+        # span label — default engines' spans stay byte-identical (the
+        # grad_sync= idiom: the default mode is unlabeled).
+        self.kv_dtype_explicit = kv_dtype is not None
+        self.kv_dtype = kv_dtype or _DTYPE_SHORT.get(
+            jnp.dtype(cfg.dtype).name, jnp.dtype(cfg.dtype).name
+        )
 
         # -- paged KV pool (ISSUE 7 tentpole) --------------------------------
         # kv_pages selects the paged engine: HBM holds a fixed pool of
@@ -541,8 +589,10 @@ class Engine:
                 ),
             )
             if self.paged:
-                cs = paged_cache_specs(tp_axis)
-                sharding = _trimmed_sharding(world, cs.k)
+                cs = paged_cache_specs(tp_axis, quantized=self.kv_quantized)
+                sharding = _trimmed_sharding(
+                    world, cs.k.q if self.kv_quantized else cs.k
+                )
                 rep = jax.sharding.PartitionSpec()
                 fwd = world.shard_map(
                     functools.partial(
@@ -553,8 +603,10 @@ class Engine:
                     out_specs=(rep, cs),
                 )
             else:
-                cs = cache_specs(tp_axis)
-                sharding = _trimmed_sharding(world, cs.k)
+                cs = cache_specs(tp_axis, quantized=self.kv_quantized)
+                sharding = _trimmed_sharding(
+                    world, cs.k.q if self.kv_quantized else cs.k
+                )
                 fwd = world.shard_map(
                     functools.partial(
                         _tp_cache_forward, cfg=cfg, axis=tp_axis,
@@ -613,13 +665,19 @@ class Engine:
                     jax.tree.map(lambda _: drep, draft_params),
                 )
             if self.paged:
+                # The draft pool mirrors the target's page geometry AND
+                # its wire dtype (ISSUE 15): shared block tables carry
+                # quantized draft K/V + scales through COW / prefix
+                # sharing / preemption exactly as the target's.
                 self.draft_cache = alloc_paged_cache(
                     draft_cfg, slots, self.num_pages, self.page_size,
-                    sharding=drep,
+                    sharding=drep, dtype=self._cache_dtype,
+                    quantized=self.kv_quantized,
                 )
             else:
                 self.draft_cache = alloc_cache(
-                    draft_cfg, slots, self.max_len, sharding=drep
+                    draft_cfg, slots, self.max_len, sharding=drep,
+                    dtype=self._cache_dtype, quantized=self.kv_quantized,
                 )
             if drep is not None:
                 # lengths too — alloc_* shards only K/V, but a later
@@ -641,7 +699,8 @@ class Engine:
             )
             self.cache = alloc_paged_cache(
                 cfg, slots, self.num_pages, self.page_size,
-                sharding=sharding,
+                sharding=sharding, dtype=self._cache_dtype,
+                quantized=self.kv_quantized,
             )
             self._prefill_paged_jit = jax.jit(self._paged_prefill_step)
             if self.spec_k:
@@ -653,7 +712,8 @@ class Engine:
         else:
             self.allocator = None
             self.cache = alloc_cache(
-                cfg, slots, self.max_len, sharding=sharding
+                cfg, slots, self.max_len, sharding=sharding,
+                dtype=self._cache_dtype, quantized=self.kv_quantized,
             )
             self._prefill_jit = jax.jit(self._prefill_step)
             if self.spec_k:
@@ -700,12 +760,13 @@ class Engine:
                 if hasattr(l, "dtype")
             )
         )
-        # One cached K (or V) row of one layer, in the cache dtype —
-        # the unit of the length-aware decode-bytes model.
-        self._kv_row_bytes = float(
-            self.cfg.num_heads
-            * self.cfg.head_dim
-            * jnp.dtype(self.cache.k.dtype).itemsize
+        # One cached K (or V) row of one layer, at the ACTUAL wire
+        # dtype — the unit of the length-aware decode-bytes model.
+        # int8 rows carry their scale blocks (ISSUE 15 roofline
+        # honesty: the visited-tile sweep DMAs int8 tiles + scales, so
+        # that is what decode_hbm_util_pct / GB-s figures must count).
+        self._kv_row_bytes = kv_wire_bytes_per_row(
+            self.cfg.num_heads, self.cfg.head_dim, self.cache.k.dtype
         )
 
     # -- jitted step bodies -------------------------------------------------
@@ -778,8 +839,8 @@ class Engine:
         )
         sel = admit[None, :, None, None, None]
         new_cache = KVCache(
-            k=jnp.where(sel, new.k, cache.k),
-            v=jnp.where(sel, new.v, cache.v),
+            k=_kv_where(sel, new.k, cache.k),
+            v=_kv_where(sel, new.v, cache.v),
             lengths=jnp.where(admit, prompt_lens, cache.lengths),
         )
         new_last = jnp.where(admit, tok, last)
@@ -792,8 +853,8 @@ class Engine:
             dparams, tokens, dfresh, with_head=False
         )
         return new_cache, new_last, KVCache(
-            k=jnp.where(sel, dnew.k, dcache.k),
-            v=jnp.where(sel, dnew.v, dcache.v),
+            k=_kv_where(sel, dnew.k, dcache.k),
+            v=_kv_where(sel, dnew.v, dcache.v),
             lengths=new_cache.lengths,
         )
 
@@ -816,8 +877,8 @@ class Engine:
         sel = active[None, :, None, None, None]
         return (
             KVCache(
-                k=jnp.where(sel, new.k, cache.k),
-                v=jnp.where(sel, new.v, cache.v),
+                k=_kv_where(sel, new.k, cache.k),
+                v=_kv_where(sel, new.v, cache.v),
                 lengths=jnp.where(active, lens + 1, lens),
             ),
             jnp.where(active, tok, last),
@@ -1075,8 +1136,8 @@ class Engine:
         else:
             sel = active[None, :, None, None, None]
             out_cache = KVCache(
-                k=jnp.where(sel, new.k, cache.k),
-                v=jnp.where(sel, new.v, cache.v),
+                k=_kv_where(sel, new.k, cache.k),
+                v=_kv_where(sel, new.v, cache.v),
                 lengths=lens + n_emit,
             )
         return out_cache, new_last, emit, n_emit, n_acc
@@ -1089,12 +1150,18 @@ class Engine:
         copies its page too."""
 
         def cp(pool):
-            page = jax.lax.dynamic_index_in_dim(
-                pool, src, axis=1, keepdims=True
-            )
-            return jax.lax.dynamic_update_slice_in_dim(
-                pool, page, dst, axis=1
-            )
+            # tree-mapped: a quantized pool copies its int8 page AND
+            # the page's scale block in the same remap (ISSUE 15 —
+            # COW carries the scales with the pages).
+            def cp1(pl):
+                page = jax.lax.dynamic_index_in_dim(
+                    pl, src, axis=1, keepdims=True
+                )
+                return jax.lax.dynamic_update_slice_in_dim(
+                    pl, page, dst, axis=1
+                )
+
+            return jax.tree.map(cp1, pool)
 
         out = PagedKVCache(
             k=cp(cache.k), v=cp(cache.v), lengths=cache.lengths
@@ -1428,19 +1495,28 @@ class Engine:
         self.roofline_costs = out
         return out
 
-    def decode_achieved_hbm_bytes(self, live_lens, t_q: int = 1):
+    def decode_achieved_hbm_bytes(
+        self, live_lens, t_q: int = 1, *, include_params: bool = True
+    ):
         """Length-aware modeled HBM bytes for ONE decode tick:
         ``live_lens`` are the live slots' cache fills (host mirror) at
         tick start. Visited K/V tiles come from the host formula
         :func:`~mpit_tpu.ops.decode_attention.num_kv_blocks` — pinned
         bitwise against the kernel's own in-kernel visited count — plus
         one tile per clamped free slot, the param read, and the
-        appended rows. ``t_q`` is the tick's query width (1 plain;
-        ``spec_k + 1`` for a speculative verify — its tile bound is
-        ``ceil((L + k + 1)/block_k)``). ``None`` on the dense reference
-        engine (no tiling claim to account); on the off-TPU kernel
-        fallback the figure is the MODEL of the kernel path (the
-        platform label on the registered cost marks it modeled)."""
+        appended rows, all at the cache's ACTUAL wire dtype
+        (``kv_dtype``: int8 tiles + scale blocks under quantization —
+        ISSUE 15 honesty: utilization figures must count what the DMA
+        moves, not the logical f32 view). ``t_q`` is the tick's query
+        width (1 plain; ``spec_k + 1`` for a speculative verify — its
+        tile bound is ``ceil((L + k + 1)/block_k)``).
+        ``include_params=False`` drops the (dtype-independent) param
+        read — the KV-sweep-only figure the bench's kv-dtype A/B
+        ratios, since the sweep is the term quantization shrinks.
+        ``None`` on the dense reference engine (no tiling claim to
+        account); on the off-TPU kernel fallback the figure is the
+        MODEL of the kernel path (the platform label on the registered
+        cost marks it modeled)."""
         if self.decode_attention == "reference":
             return None
         lens = np.asarray(live_lens)
@@ -1453,7 +1529,7 @@ class Engine:
             block_k=self.decode_block_k,
             kv_row_bytes=self._kv_row_bytes,
             num_layers=self.cfg.num_layers,
-            param_bytes=self._param_bytes,
+            param_bytes=self._param_bytes if include_params else 0.0,
             appended_rows=lens.size * t_q,
         )
 
@@ -1462,10 +1538,11 @@ class Engine:
 
     def reset(self, seed: int = 0) -> None:
         """Clear all slots (bench warmup path); compiled steps survive."""
+        zeros = lambda kv: jax.tree.map(jnp.zeros_like, kv)
         cls = PagedKVCache if self.paged else KVCache
         self.cache = cls(
-            k=jnp.zeros_like(self.cache.k),
-            v=jnp.zeros_like(self.cache.v),
+            k=zeros(self.cache.k),
+            v=zeros(self.cache.v),
             lengths=jnp.zeros_like(self.cache.lengths),
         )
         self.last_token = jnp.zeros_like(self.last_token)
@@ -1473,8 +1550,8 @@ class Engine:
         self._spec_state = None
         if self.draft_cache is not None:
             self.draft_cache = type(self.draft_cache)(
-                k=jnp.zeros_like(self.draft_cache.k),
-                v=jnp.zeros_like(self.draft_cache.v),
+                k=zeros(self.draft_cache.k),
+                v=zeros(self.draft_cache.v),
                 lengths=jnp.zeros_like(self.draft_cache.lengths),
             )
         if self.paged:

@@ -38,6 +38,20 @@ list, per-page refcounts, the rolling-hash prefix index and the
 copy-on-write bookkeeping. Validity still comes from ``lengths`` + the
 attention mask, never from buffer contents, so freed pages are recycled
 without zeroing.
+
+QUANTIZED pools (ISSUE 15). ``quantized=True`` on the alloc/specs
+builders puts a :class:`~mpit_tpu.ops.kv_quant.QuantizedKV` (int8
+payload + per-(row, head) f32 scale blocks, equal rank) in every K/V
+seat: the page's scale block ``[page_size, H]`` lives in the same
+pytree as its int8 rows, so the allocator, COW remaps, prefix sharing
+and preemption carry scales with the pages WITHOUT learning about them
+— a block-table indirection or page copy applies to both leaves. Bytes
+per cached token drop ~2× vs bf16 (~4× vs f32); capacity at fixed HBM
+roughly doubles (:func:`~mpit_tpu.ops.kv_quant.kv_wire_bytes_per_row`
+is the sizing rule the roofline model and the bench capacity sweep
+share). Recycled pages need no scale scrubbing for the same reason
+rows need no zeroing: the mask defines validity, and every valid row's
+scale was written by that row's own quantize-on-write.
 """
 
 from __future__ import annotations
@@ -50,6 +64,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from mpit_tpu.ops.kv_quant import QuantizedKV, kv_wire_bytes_per_row
+
 __all__ = [
     "KVCache",
     "alloc_cache",
@@ -60,7 +76,21 @@ __all__ = [
     "PageAllocator",
     "AdmitPlan",
     "pages_needed",
+    "QuantizedKV",
+    "kv_wire_bytes_per_row",
 ]
+
+
+def _alloc_kv(shape, dtype, quantized, kw):
+    """One K (or V) buffer: a zeroed dense array, or the quantized pair
+    (int8 payload + keepdims f32 scale — zero scales dequantize the
+    zeroed payload to exact zeros, matching the dense init)."""
+    if not quantized:
+        return jnp.zeros(shape, dtype, **kw)
+    return QuantizedKV(
+        q=jnp.zeros(shape, jnp.int8, **kw),
+        scale=jnp.zeros(shape[:-1] + (1,), jnp.float32, **kw),
+    )
 
 
 @jax.tree_util.register_pytree_node_class
@@ -100,31 +130,38 @@ def alloc_cache(
     *,
     dtype=None,
     sharding=None,
+    quantized: bool = False,
 ) -> KVCache:
     """Allocate the zeroed cache for ``slots`` concurrent requests.
 
     ``dtype`` defaults to the model's activation dtype (``cfg.dtype``) —
     the K/V written by the blocks arrive in it. ``sharding``: optional
     ``NamedSharding`` for the buffers (the TP engine passes the
-    head-sharded one from :func:`cache_specs`).
+    head-sharded one from :func:`cache_specs`). ``quantized`` (ISSUE
+    15): int8 + per-(row, head) scale buffers instead — writes
+    quantize, reads dequantize per tile.
     """
     shape = (cfg.num_layers, slots, max_len, cfg.num_heads, cfg.head_dim)
     dt = dtype or cfg.dtype
     kw = {"device": sharding} if sharding is not None else {}
     return KVCache(
-        k=jnp.zeros(shape, dt, **kw),
-        v=jnp.zeros(shape, dt, **kw),
+        k=_alloc_kv(shape, dt, quantized, kw),
+        v=_alloc_kv(shape, dt, quantized, kw),
         lengths=jnp.zeros((slots,), jnp.int32),
     )
 
 
-def cache_specs(axis: str = "model") -> KVCache:
+def cache_specs(axis: str = "model", *, quantized: bool = False) -> KVCache:
     """PartitionSpecs for a :class:`KVCache` under tensor parallelism:
     K/V sharded on the HEAD dim (axis 3 of [L, S, T, H, Dh]) — each TP
     rank caches exactly its column-sharded qkv heads — lengths
     replicated. Shaped as a KVCache so it drops into shard_map
-    ``in_specs``/``out_specs`` positionally."""
+    ``in_specs``/``out_specs`` positionally. Quantized caches shard the
+    scale blocks on the SAME head axis (axis 3 of [L, S, T, H, 1]) —
+    each rank's heads carry their own scales."""
     kv = P(None, None, None, axis, None)
+    if quantized:
+        kv = QuantizedKV(q=kv, scale=kv)
     return KVCache(k=kv, v=kv, lengths=P())
 
 
@@ -178,26 +215,35 @@ def alloc_paged_cache(
     *,
     dtype=None,
     sharding=None,
+    quantized: bool = False,
 ) -> PagedKVCache:
     """Allocate the zeroed page pool. HBM cost is ``num_pages ×
     page_size`` cache rows — chosen by budget, independent of ``slots``
-    (the batch width) and of any per-slot ``max_len``."""
+    (the batch width) and of any per-slot ``max_len``. ``quantized``
+    (ISSUE 15): int8 pages + per-(row, head) scale blocks — a page
+    costs ``page_size × kv_wire_bytes_per_row(H, Dh, "int8")`` bytes,
+    so the same budget holds ~2× the pages of a bf16 pool."""
     shape = (cfg.num_layers, num_pages, page_size, cfg.num_heads,
              cfg.head_dim)
     dt = dtype or cfg.dtype
     kw = {"device": sharding} if sharding is not None else {}
     return PagedKVCache(
-        k=jnp.zeros(shape, dt, **kw),
-        v=jnp.zeros(shape, dt, **kw),
+        k=_alloc_kv(shape, dt, quantized, kw),
+        v=_alloc_kv(shape, dt, quantized, kw),
         lengths=jnp.zeros((slots,), jnp.int32),
     )
 
 
-def paged_cache_specs(axis: str = "model") -> PagedKVCache:
+def paged_cache_specs(
+    axis: str = "model", *, quantized: bool = False
+) -> PagedKVCache:
     """TP PartitionSpecs for the pool: heads (axis 3 of [L, P, ps, H,
     Dh]) shard exactly as the dense cache's; pages are replicated-id
-    shared state, lengths replicated."""
+    shared state, lengths replicated. Quantized pools shard the scale
+    blocks on the same head axis."""
     kv = P(None, None, None, axis, None)
+    if quantized:
+        kv = QuantizedKV(q=kv, scale=kv)
     return PagedKVCache(k=kv, v=kv, lengths=P())
 
 

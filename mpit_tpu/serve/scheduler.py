@@ -301,6 +301,15 @@ class Server:
             engine, "decode_attention_mode", "reference"
         )
         self._sampler = getattr(engine, "decode_sampler", "dense")
+        # kv_dtype rides decode/prefill spans via the attention= idiom
+        # (ISSUE 15 satellite) — but only when the engine's wire dtype
+        # was EXPLICITLY chosen: default engines' spans stay
+        # byte-identical to HEAD, like grad_sync='s unlabeled psum.
+        self._kv_attrs = (
+            {"kv_dtype": engine.kv_dtype}
+            if getattr(engine, "kv_dtype_explicit", False)
+            else {}
+        )
         self._paged = bool(getattr(engine, "paged", False))
         # Speculative decoding (ISSUE 13): spec_k > 0 swaps the decode
         # tick for draft-then-verify; the accumulators feed stats()'s
@@ -678,6 +687,7 @@ class Server:
             attention=self._attn_mode,
             sampler=self._sampler,
             rids=[live.req.rid for live in self.prefilling.values()],
+            **self._kv_attrs,
         ):
             first = eng.prefill_paged(
                 tokens, base, chunk_lens, floor, sample_mask,
@@ -763,6 +773,7 @@ class Server:
             # of the summary's label roll-up but lands in the trace
             # args) — one request's lifeline is filterable in Perfetto.
             rids=[live.req.rid for _, live in batch],
+            **self._kv_attrs,
         ):
             first = self.engine.prefill(
                 tokens, lens, admit, self._temp, self._topk
@@ -874,16 +885,17 @@ class Server:
         with obs.span(
             "decode", active=n_live, attention=self._attn_mode,
             sampler=self._sampler, spec_k=k, rids=rids,
+            **self._kv_attrs,
         ):
             with obs.span(
                 "spec_draft", active=n_live, attention=self._attn_mode,
-                sampler=self._sampler, rids=rids,
+                sampler=self._sampler, rids=rids, **self._kv_attrs,
             ):
                 eng.spec_draft(active, self._temp, self._topk)
             t1 = time.perf_counter()
             with obs.span(
                 "spec_verify", active=n_live, attention=self._attn_mode,
-                sampler=self._sampler, rids=rids,
+                sampler=self._sampler, rids=rids, **self._kv_attrs,
             ):
                 emit, n_emit, n_acc = eng.spec_verify(
                     active, self._temp, self._topk, budget, eos
@@ -980,6 +992,7 @@ class Server:
             "decode", active=int(active.sum()), attention=self._attn_mode,
             sampler=self._sampler,
             rids=[live.req.rid for live in self.live.values()],
+            **self._kv_attrs,
         ):
             toks = self.engine.decode(active, self._temp, self._topk)
         now = time.perf_counter()
@@ -1235,6 +1248,13 @@ class Server:
             # — the capacity number the paged-vs-dense bench pins.
             "concurrency_peak": self._concurrency_peak,
         }
+        # The cache's wire dtype (ISSUE 15): what a cached row occupies
+        # HBM as — "int8" on the quantized engines, the model dtype
+        # otherwise. Always reported: capacity and bandwidth figures
+        # are uninterpretable without it.
+        kv_dtype = getattr(self.engine, "kv_dtype", None)
+        if kv_dtype is not None:
+            out["kv_dtype"] = kv_dtype
         watch = getattr(self.engine, "compile_watch", None)
         if watch is not None:
             # The runtime-guarded compile claim (ISSUE 8): 2 for the

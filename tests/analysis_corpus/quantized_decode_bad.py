@@ -1,0 +1,35 @@
+"""Corpus: the quantized-decode jaxpr contract catches a whole-pool
+dequant (ISSUE 15).
+
+``attend`` spells the tempting-but-wrong int8 read path: dequantize the
+ENTIRE page pool to f32 up front, then gather and attend — exactly the
+full-pool f32 intermediate the fused kernel exists to avoid (it would
+make the decode sweep move MORE bytes than the unquantized cache).
+Unlike the static-rule corpus twins this file IS imported (by
+``tests/test_analysis.py::TestQuantizedDecodeCorpus``) and traced;
+``assert_no_intermediate(..., dtype=float32)`` must flag the pool-shaped
+f32 output. No static rule fires here — the whole-corpus lint pin stays
+at its seven seeded violations.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from mpit_tpu.ops.ring_collectives import dequantize_blocks
+
+POOL_PAGES, PAGE_SIZE, HEADS, HEAD_DIM = 8, 4, 2, 8
+
+
+def attend(q, pool_q, pool_scale, block_table, lengths):
+    """q [B, 1, H, Dh] vs an int8 pool [P, ps, H, Dh] + scales
+    [P, ps, H, 1]: dequantizes the WHOLE pool first — the violation."""
+    pool_f32 = dequantize_blocks(pool_q, pool_scale)  # [P, ps, H, Dh] f32
+    g = pool_f32[block_table]  # [B, n_ps, ps, H, Dh]
+    k = g.reshape(g.shape[0], -1, *g.shape[3:])
+    dh = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(1.0 * dh)
+    s_max = k.shape[1]
+    valid = jnp.arange(s_max)[None, None, :] <= lengths[:, None, None]
+    scores = jnp.where(valid[:, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, k)

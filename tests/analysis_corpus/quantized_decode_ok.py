@@ -1,0 +1,42 @@
+"""Corpus: the per-tile dequant discipline passes the quantized-decode
+contract (ISSUE 15) — the false-positive guard for
+``quantized_decode_bad.py``.
+
+``attend`` reads the same int8 pool one PAGE-sized tile at a time:
+each iteration gathers one page's int8 rows + its scale block,
+dequantizes at tile size, and folds it into a running (unnormalized)
+attention accumulator — so the largest f32 K-shaped intermediate is
+``[B, page_size, H, Dh]``, never the pool or a slot's dense view. The
+pool-shaped f32 aval the contract hunts must NOT appear. (The real
+kernel's online-softmax is numerically stronger; this corpus entry
+pins only the materialization discipline.) No static rule fires here.
+"""
+
+import jax.numpy as jnp
+
+from mpit_tpu.ops.ring_collectives import dequantize_blocks
+
+POOL_PAGES, PAGE_SIZE, HEADS, HEAD_DIM = 8, 4, 2, 8
+
+
+def attend(q, pool_q, pool_scale, block_table, lengths):
+    """q [B, 1, H, Dh] vs int8 pool [P, ps, H, Dh] + scales
+    [P, ps, H, 1], dequantized per page tile — the clean idiom."""
+    b = q.shape[0]
+    ps = pool_q.shape[1]
+    dh = q.shape[-1]
+    n_ps = block_table.shape[1]
+    num = jnp.zeros(q.shape, jnp.float32)
+    den = jnp.zeros((b, 1, q.shape[2], 1), jnp.float32)
+    for i in range(n_ps):
+        page = block_table[:, i]  # [B]
+        k_tile = dequantize_blocks(
+            pool_q[page], pool_scale[page]
+        )  # [B, ps, H, Dh] f32 — tile-sized, the allowed grain
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q, k_tile) / jnp.sqrt(1.0 * dh)
+        pos = i * ps + jnp.arange(ps)
+        valid = pos[None, None, :] <= lengths[:, None, None]
+        w = jnp.where(valid[:, None], jnp.exp(sc), 0.0)
+        num = num + jnp.einsum("bhqk,bkhd->bqhd", w, k_tile)
+        den = den + jnp.sum(w, axis=-1)[..., None].transpose(0, 2, 1, 3)
+    return num / jnp.maximum(den, 1e-9)

@@ -331,6 +331,88 @@ class TestJaxprLibrary:
         assert any("went dark" in v.message for v in out)
         assert all(v.rule == "jaxpr-contracts" for v in out)
 
+    def test_find_avals_dtype_filter(self):
+        """ISSUE 15: the quantized-decode contract needs shape+dtype —
+        an int8 buffer legitimately carries the pool shape, and only a
+        float32 aval of it means the dequant escaped its tile."""
+        import jax
+        import jax.numpy as jnp
+
+        def f(q):  # int8 in, f32 out — SAME shape both dtypes
+            return q.astype(jnp.float32) * 2.0
+
+        jx = jax.make_jaxpr(f)(jnp.zeros((4, 8), jnp.int8))
+        f32 = jnp.dtype(jnp.float32)
+        assert jaxpr_check.find_avals(jx, (4, 8), dtype=f32)
+        assert not jaxpr_check.find_avals(
+            jx, (4, 8), dtype=jnp.dtype(jnp.int16)
+        )
+        with pytest.raises(jaxpr_check.JaxprContractError):
+            jaxpr_check.assert_no_intermediate(jx, (4, 8), dtype=f32)
+        # The int8 INPUT is not an eqn output; only produced avals count
+        # — and unfiltered behavior is unchanged (back-compat).
+        assert jaxpr_check.find_avals(jx, (4, 8))
+        jaxpr_check.assert_no_intermediate(jx, (9, 9), dtype=f32)
+        with pytest.raises(jaxpr_check.JaxprContractError):
+            jaxpr_check.assert_intermediate(
+                jx, (4, 8), dtype=jnp.dtype(jnp.bfloat16)
+            )
+
+
+class TestQuantizedDecodeCorpus:
+    """ISSUE 15 corpus pair: the traced quantized-decode discipline —
+    whole-pool dequant is caught, per-tile dequant passes. (The real
+    engine's contract lives in the sweep; this pins the DETECTOR on
+    minimal seeded code, like the static rules' corpus.)"""
+
+    def _trace(self, name):
+        import importlib.util
+
+        import jax
+        import jax.numpy as jnp
+
+        spec = importlib.util.spec_from_file_location(
+            name, corpus(f"{name}.py")
+        )
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        P, ps, H, D = m.POOL_PAGES, m.PAGE_SIZE, m.HEADS, m.HEAD_DIM
+        jx = jax.make_jaxpr(m.attend)(
+            jnp.zeros((2, 1, H, D), jnp.float32),
+            jnp.zeros((P, ps, H, D), jnp.int8),
+            jnp.ones((P, ps, H, 1), jnp.float32),
+            jnp.zeros((2, 3), jnp.int32),
+            jnp.zeros((2,), jnp.int32),
+        )
+        return jx, (P, ps, H, D)
+
+    def test_bad_whole_pool_dequant_is_caught(self):
+        import jax.numpy as jnp
+
+        jx, pool = self._trace("quantized_decode_bad")
+        with pytest.raises(
+            jaxpr_check.JaxprContractError, match="materializes"
+        ):
+            jaxpr_check.assert_no_intermediate(
+                jx, pool, what="corpus bad",
+                dtype=jnp.dtype(jnp.float32),
+            )
+
+    def test_ok_per_tile_dequant_passes(self):
+        import jax.numpy as jnp
+
+        jx, pool = self._trace("quantized_decode_ok")
+        jaxpr_check.assert_no_intermediate(
+            jx, pool, what="corpus ok", dtype=jnp.dtype(jnp.float32)
+        )
+
+    def test_corpus_pair_seeds_no_static_violations(self):
+        """The pair must not disturb the whole-corpus lint pin (their
+        violations are traced, not AST)."""
+        for name in ("quantized_decode_bad", "quantized_decode_ok"):
+            code, violations = run_static([corpus(f"{name}.py")])
+            assert code == 0, [v.format() for v in violations]
+
 
 class TestLockdep:
     def _mk_locks(self, n):

@@ -256,6 +256,63 @@ def _realistic_results():
                 },
                 "accepted_tokens_per_tick": 3.6123,
             },
+            # ISSUE 15: the cache wire dtype + the capacity-at-fixed-
+            # HBM ratio ride the line; the quantized A/B, capacity,
+            # quality-gate and spec-neutrality blocks are detail-only.
+            "kv_dtype": "bf16",
+            "q8_capacity_ratio": 12.25,
+            "quantized_kv": {
+                "geometry": {"vocab": 256, "d_model": 256,
+                             "num_layers": 2, "num_heads": 4,
+                             "head_dim": 64, "slots": 8, "max_len": 96,
+                             "prompt_len": 64, "max_new": 16,
+                             "page_size": 16, "train_steps": 300},
+                "ab": {
+                    "f32": {"decode_tokens_per_sec": 12093.6,
+                            "decode_hbm_bytes_modeled": 138940416.0},
+                    "bf16": {"decode_tokens_per_sec": 11962.3,
+                             "decode_hbm_bytes_modeled": 120311808.0},
+                    "int8": {"decode_tokens_per_sec": 12214.9,
+                             "decode_hbm_bytes_modeled": 111579648.0},
+                    "q8_kv_sweep_ratio_vs_bf16": 0.5312,
+                    "q8_kv_sweep_ratio_vs_f32": 0.2656,
+                    "q8_total_bytes_ratio_vs_bf16": 0.9233,
+                    "kv_row_bytes": {"f32": 1024.0, "bf16": 512.0,
+                                     "int8": 272.0},
+                },
+                "capacity": {
+                    "pool_budget_bytes": 196608,
+                    "page_size": 16,
+                    "request_shape": {"prompt_len": 64, "max_new": 16,
+                                      "pages_per_request": 5,
+                                      "requests": 30, "slots": 16},
+                    "bf16": {"pages": 24, "max_concurrent": 4,
+                             "pool_occupancy_peak": 0.8333,
+                             "decode_tokens_per_sec": 1420.1},
+                    "int8": {"pages": 45, "max_concurrent": 9,
+                             "pool_occupancy_peak": 1.0,
+                             "decode_tokens_per_sec": 1798.8},
+                    "q8_capacity_ratio": 12.25,
+                    "row_bytes_ratio_bf16_over_int8": 1.8824,
+                },
+                "quality": {
+                    "target_final_loss": 0.0004,
+                    "logit_abs_err_max": 0.05789,
+                    "logit_abs_err_mean": 0.005502,
+                    "logit_err_nonzero": True,
+                    "greedy_agreement_vs_f32": {"bf16": 1.0,
+                                                "int8": 1.0},
+                },
+                "speculative_neutrality": {
+                    "bf16": {"draft_acceptance_rate": 1.0,
+                             "accepted_tokens_per_tick": 3.75},
+                    "int8": {"draft_acceptance_rate": 1.0,
+                             "accepted_tokens_per_tick": 3.75},
+                    "acceptance_delta": 0.0,
+                },
+                "q8_capacity_ratio": 12.25,
+                "q8_kv_sweep_ratio": 0.5312,
+            },
             "reference_decode_tokens_per_sec": 98765.4,
             "serve_tokens_per_sec": 98765.4,
             "latency_p50_s": 1.234567,
@@ -493,15 +550,14 @@ class TestLineBudget:
         serve = rec["detail"]["gpt2_serve"]
         assert serve["decode_tokens_per_sec"] == 123456.7
         assert serve["decode_attention"] == "reference"
-        # ISSUE 8: the pinned lifetime compile count rides the serve
-        # line; the modeled GB/s and the platform label stay
-        # detail-only — and decode_hbm_util_pct joined them (ISSUE 13
-        # budget payment: exactly derivable from
-        # decode_hbm_gbps_modeled + the platform's chip peak).
-        assert serve["engine_compiles"] == 2
+        # ISSUE 8's modeled GB/s + platform label stay detail-only —
+        # decode_hbm_util_pct joined them (ISSUE 13) and
+        # engine_compiles joined them too (ISSUE 15 budget payment:
+        # the value is pinned to the lifetime constant by tier-1, so
+        # the line key carried no information).
         assert "decode_hbm_gbps_modeled" not in serve
         assert "roofline_platform" not in serve
-        assert serve["latency_p95_s"] == 2.345678
+        assert "engine_compiles" not in serve
         # ISSUE 13: the speculative tokens-per-slot-tick multiplier
         # rides the line; the A/B block (trained pair + random-draft
         # floor, per-context acceptance, tokens/s both ways, TTFT
@@ -514,6 +570,13 @@ class TestLineBudget:
         # moved detail-only to pay for ISSUE 12's gpt2_policy triple).
         assert serve["prefix_hit_rate"] == 0.9792
         assert serve["max_concurrent_at_hbm"] == 128
+        # ISSUE 15: the cache wire dtype and the int8-vs-bf16 capacity
+        # ratio at the same pool budget ride the line; the quantized
+        # A/B / capacity / quality / neutrality blocks are detail-only,
+        # and latency_p95_s moved detail-only to pay (the SLO-relevant
+        # p95 verdicts live on the gpt2_slo/gpt2_policy lines).
+        assert serve["kv_dtype"] == "bf16"
+        assert serve["q8_capacity_ratio"] == 12.25
         # latency_p50_s and slots moved detail-only to pay for the
         # ISSUE 8 keys (p95 is the SLO-relevant percentile; slots is
         # static geometry — both stay in BENCH_DETAIL.json verbatim).
@@ -523,7 +586,8 @@ class TestLineBudget:
                         "decode_sampler", "paged_capacity",
                         "chunked_prefill", "latency_p50_s", "slots",
                         "kv_page_size", "speculative",
-                        "decode_hbm_util_pct",
+                        "decode_hbm_util_pct", "latency_p95_s",
+                        "quantized_kv",
                         "reference_decode_tokens_per_sec"):
             assert off_line not in serve
         # The SLO sweep (ISSUE 6): max sustained req/s at p95 TTFT ≤
@@ -726,6 +790,70 @@ class TestSpeculativeArtifact:
         tr = e["speculative"]["trained"]
         assert tr["target_final_loss"] < 0.5
         assert tr["draft_final_loss"] < 0.5
+
+
+class TestQuantizedKVArtifact:
+    """ISSUE 15 acceptance, pinned against the committed artifact: the
+    gpt2_serve quantized_kv block must show the modeled decode KV sweep
+    ≤ 0.55× of bf16 at the bench stream's lengths, capacity ≥ 1.9× at
+    the same pool HBM budget, and the quality gates (logit bound +
+    anti-vacuity, greedy stability on the trained checkpoint, spec
+    acceptance neutrality) holding with deltas recorded."""
+
+    def _block(self):
+        from pathlib import Path
+
+        detail = json.loads(
+            (Path(bench.__file__).parent / "BENCH_DETAIL.json").read_text()
+        )
+        assert "gpt2_serve" in detail["workloads"], (
+            "BENCH_DETAIL.json has no gpt2_serve entry — re-run "
+            "`python bench.py` (or the standalone gpt2_serve run)"
+        )
+        entry = detail["workloads"]["gpt2_serve"]
+        assert "quantized_kv" in entry
+        return entry
+
+    def test_kv_sweep_ratio_at_most_055_of_bf16(self):
+        e = self._block()
+        ab = e["quantized_kv"]["ab"]
+        assert ab["q8_kv_sweep_ratio_vs_bf16"] <= 0.55
+        assert ab["q8_kv_sweep_ratio_vs_f32"] <= 0.28
+        # Honesty twin: the TOTAL ratio (param read included) is also
+        # recorded — on the CPU-sized bench model params dominate, and
+        # the record must say so rather than imply a whole-tick 2x.
+        assert ab["q8_total_bytes_ratio_vs_bf16"] > ab[
+            "q8_kv_sweep_ratio_vs_bf16"
+        ]
+
+    def test_capacity_ratio_at_least_19_at_fixed_budget(self):
+        e = self._block()
+        cap = e["quantized_kv"]["capacity"]
+        assert cap["q8_capacity_ratio"] >= 1.9
+        # Same byte budget, honestly derived from the wire row bytes.
+        assert cap["int8"]["pages"] > cap["bf16"]["pages"]
+        assert e["q8_capacity_ratio"] == cap["q8_capacity_ratio"]
+
+    def test_quality_gates_recorded_and_nonvacuous(self):
+        e = self._block()
+        q = e["quantized_kv"]["quality"]
+        assert q["target_final_loss"] < 0.5  # trained, not random
+        assert q["logit_err_nonzero"], "lossy path never executed"
+        assert q["logit_abs_err_max"] < 0.5
+        assert q["greedy_agreement_vs_f32"]["int8"] >= 0.95
+
+    def test_spec_acceptance_neutral(self):
+        e = self._block()
+        sp = e["quantized_kv"]["speculative_neutrality"]
+        assert sp["acceptance_delta"] is not None
+        assert abs(sp["acceptance_delta"]) <= 0.05
+
+    def test_line_kv_dtype_is_headline_streams_wire_dtype(self):
+        e = self._block()
+        # The headline stream runs the default cache — its wire dtype
+        # (the model dtype) rides the line so bandwidth figures are
+        # attributable.
+        assert e["kv_dtype"] in ("f32", "bf16", "int8")
 
 
 class TestPolicyArtifact:
