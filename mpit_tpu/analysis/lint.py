@@ -30,6 +30,13 @@ Thirteen PRs of informal discipline, encoded (ISSUE 14 tentpole):
   messaging (``Send``/``Recv``/...) must ``bind_thread`` first, or its
   traffic is attributed to whatever rank last ran on that thread (the
   elastic heartbeat bug class, fixed in PR 10 round-2 review).
+- ``ledger-seam`` — every scheduler/policy decision seam named in
+  ``DEFAULT_CONFIG.ledger_seams`` must emit a request-ledger event (a
+  call through an attr chain containing "ledger") or carry an
+  ``# analysis: allow(ledger-seam)`` suppression stating where the
+  decision IS ledgered: a new decision point that silently skips the
+  ledger makes exactly the requests it touches invisible to why-slow
+  forensics (ISSUE 16).
 
 Device-value tracking for ``host-sync-in-hot-seam`` is a local taint
 pass: seeds are calls into ``jnp.*`` / ``jax.*``, jitted handles
@@ -78,6 +85,11 @@ R_THREAD_BIND = register_rule(
     "thread-bind",
     "helper thread touches compat messaging without bind_thread",
 )
+R_LEDGER_SEAM = register_rule(
+    "ledger-seam",
+    "scheduler/policy decision seam emits no request-ledger event — "
+    "new decision points must not go dark in why-slow forensics",
+)
 
 
 @dataclasses.dataclass
@@ -96,6 +108,10 @@ class LintConfig:
     determinism_modules: frozenset = frozenset()
     # obs.span names that label a deliberate host fence
     fence_spans: frozenset = frozenset({"host_fence"})
+    # path suffix -> qualnames of request-lifecycle decision seams:
+    # each must emit a ledger event (a call through an attr chain
+    # containing "ledger") or carry # analysis: allow(ledger-seam)
+    ledger_seams: dict = dataclasses.field(default_factory=dict)
 
 
 DEFAULT_CONFIG = LintConfig(
@@ -123,6 +139,21 @@ DEFAULT_CONFIG = LintConfig(
             "mpit_tpu/serve/spec.py",
         }
     ),
+    # Request-lifecycle decision seams (ISSUE 16): every site that
+    # decides a request's fate must show up in its why-slow ledger.
+    ledger_seams={
+        "mpit_tpu/serve/scheduler.py": {
+            "Server.submit",
+            "Server._admit_paged",
+            "Server._admit_dense",
+            "Server._preempt",
+            "Server._prefill_chunk_tick",
+            "Server._decode_tick",
+            "Server._spec_tick",
+            "Server._maybe_retire",
+        },
+        "mpit_tpu/serve/policy.py": {"SchedulingPolicy.should_shed"},
+    },
 )
 
 _UTIL_KEYS = {"mfu_pct", "hbm_util_pct", "ici_util_pct"}
@@ -531,6 +562,28 @@ def _lint_thread_bind(sf: SourceFile, out: list[Violation]) -> None:
                 out.append(v)
 
 
+def _lint_ledger_seam(sf: SourceFile, qualname: str, fn, out) -> None:
+    """A configured decision seam must emit at least one ledger event —
+    any call whose attribute chain passes through a name containing
+    "ledger" (``self._ledger.event(...)``, ``ledger.retire(...)``)
+    counts; guard sites (``if self._ledger is not None:``) keep the
+    call visible even when the ledger is disabled at runtime."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if any("ledger" in part for part in chain):
+                return
+    v = sf.violation(
+        R_LEDGER_SEAM, fn,
+        f"decision seam {qualname} emits no request-ledger event — a "
+        "request deciding its fate here is invisible to why-slow "
+        "forensics; emit one or suppress with "
+        "# analysis: allow(ledger-seam)",
+    )
+    if v:
+        out.append(v)
+
+
 def lint_file(
     sf: SourceFile, cfg: LintConfig = DEFAULT_CONFIG,
     rules: set | None = None,
@@ -556,6 +609,16 @@ def lint_file(
             )
             if qualname in seam_quals or marked:
                 _lint_hot_seam(sf, qualname, fn, cfg, out)
+
+    if on(R_LEDGER_SEAM):
+        ledger_quals = set()
+        for suffix, quals in cfg.ledger_seams.items():
+            if _module_matches(sf.path, [suffix]):
+                ledger_quals |= set(quals)
+        for qualname, fn in qualname_visit(sf.tree):
+            marked = sf.func_role("ledger-seam", fn.lineno)
+            if qualname in ledger_quals or marked:
+                _lint_ledger_seam(sf, qualname, fn, out)
 
     if on(R_DETERMINISM) and (
         _module_matches(sf.path, cfg.determinism_modules)

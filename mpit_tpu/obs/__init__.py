@@ -68,6 +68,21 @@ sentinel rules for unexpected recompiles and sustained utilization
 collapse (``UtilizationWatch``); ``obs diff`` gates on utilization keys
 and refuses comparisons whose baseline phases disappeared.
 
+ISSUE 16 adds the REQUEST-FORENSICS layer (:mod:`~mpit_tpu.obs.trace`):
+a per-request lifecycle :class:`Ledger` accruing typed causal events at
+every serve decision seam (admission verdict with its projection
+inputs, slot bind, prefill chunks, decode-tick membership, COW copies,
+preemption park/resume, spec draft/accept, retire reason), bounded by
+tail-exemplar sampling — aggregate counters always on, full ledgers
+kept only for the slowest-k per SLO window, breach/anomaly-pinned
+(``Sentinel(on_note=...)``) and errored/truncated requests. A retained
+exemplar decomposes its latency into queue-wait / prefill / decode /
+parked / scheduler-gap components that reconcile against the
+``request_latency`` span; ``python -m mpit_tpu.obs why-slow`` prints
+the worst lifeline, and :class:`TraceContext` serializes over compat
+Send/Recv (dedicated tags, byte-identical) for the future
+disaggregated-fleet router.
+
 Instrumented call sites: ``train.loop.hardened_loop`` (prefetch-wait /
 step / host-fence / eval / checkpoint / divergence-restore phases),
 ``comm.collectives`` (per-op modeled wire bytes — recorded at *trace*
@@ -81,7 +96,7 @@ fast path costs a module-global check and the package can be imported
 from anywhere in the stack without cycles.
 """
 
-from mpit_tpu.obs import aggregate, baseline, roofline, slo, stream
+from mpit_tpu.obs import aggregate, baseline, roofline, slo, stream, trace
 from mpit_tpu.obs.core import (
     Recorder,
     counter,
@@ -106,14 +121,17 @@ from mpit_tpu.obs.export import (
 from mpit_tpu.obs.sentinel import Sentinel
 from mpit_tpu.obs.slo import SLO, SLOMonitor
 from mpit_tpu.obs.stream import HistogramSketch, StreamRegistry
+from mpit_tpu.obs.trace import Ledger, TraceContext
 
 __all__ = [
     "HistogramSketch",
+    "Ledger",
     "Recorder",
     "SLO",
     "SLOMonitor",
     "Sentinel",
     "StreamRegistry",
+    "TraceContext",
     "aggregate",
     "baseline",
     "counter",
@@ -134,5 +152,6 @@ __all__ = [
     "span_at",
     "stream",
     "summary",
+    "trace",
     "traffic_matrix",
 ]

@@ -21,13 +21,21 @@ Usage::
     python -m mpit_tpu.obs diff base.json cur.json --tolerance-pct 10
     python -m mpit_tpu.obs diff BENCH_DETAIL.json BENCH_DETAIL.new.json \
         --workload alexnet                       # bench snapshots
+    python -m mpit_tpu.obs why-slow BENCH_DETAIL.json  # worst exemplar
+
+**Why-slow mode** (ISSUE 16: request-ledger forensics) reads a ledger
+snapshot, a ``Server.stats()`` dump, or a BENCH_DETAIL.json with
+``trace_forensics`` blocks and prints the worst retained exemplar's
+lifeline + latency-attribution table.
 
 Exit status: 0 on success; trace mode exits 2 when the file holds no
 span events (a truncated or foreign trace — don't let an empty gap
 report read as "no overhead"); diff mode exits 1 on regressions beyond
 tolerance (phase-time growth OR a utilization drop, ISSUE 8) and 2 on
 unusable input — malformed files, truncated event buffers, or a
-baseline phase missing from the current snapshot.
+baseline phase missing from the current snapshot; why-slow mode exits
+2 on unusable input — no ledger block, zero exemplars, or a ledger
+that dropped events (forensics over holes would misattribute).
 """
 
 from __future__ import annotations
@@ -36,7 +44,7 @@ import argparse
 import json
 import sys
 
-from mpit_tpu.obs import baseline
+from mpit_tpu.obs import baseline, trace
 from mpit_tpu.obs.core import gap_attribution, phase_stats
 
 
@@ -148,11 +156,47 @@ def _main_diff(argv) -> int:
     return 0 if verdict["ok"] else 1
 
 
+def _main_why_slow(argv) -> int:
+    """The ``why-slow`` subcommand: request-ledger forensics."""
+    ap = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.obs why-slow",
+        description="Print the worst request-ledger exemplar's lifeline "
+        "+ latency attribution from a ledger snapshot, a Server.stats() "
+        "dump, or a BENCH_DETAIL.json with trace_forensics blocks.",
+    )
+    ap.add_argument("input", help="ledger snapshot / stats dump / "
+                    "BENCH_DETAIL.json")
+    ap.add_argument(
+        "--top", type=int, default=1,
+        help="how many exemplars to print, worst first",
+    )
+    args = ap.parse_args(argv)
+    try:
+        with open(args.input) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(json.dumps({"error": str(e)}))
+        return 2
+    exemplars, err = trace.collect_exemplars(doc)
+    if err is not None:
+        # Unusable input (the obs-diff rule, ISSUE 16): a ledger with
+        # dropped events would misattribute — refuse, don't guess.
+        print(json.dumps({"error": err}))
+        return 2
+    for i, ex in enumerate(exemplars[: max(args.top, 1)]):
+        if i:
+            print()
+        print(trace.format_why_slow(ex))
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "diff":
         return _main_diff(argv[1:])
+    if argv and argv[0] == "why-slow":
+        return _main_why_slow(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m mpit_tpu.obs",
         description="Offline trace summary + app-path gap attribution.",

@@ -109,10 +109,17 @@ def snapshot_trace_events(
 
 
 def export_chrome_trace(
-    path: str | Path, recorder: core.Recorder | None = None
+    path: str | Path, recorder: core.Recorder | None = None,
+    *, extra_events: list[dict] | None = None,
 ) -> Path:
     """Write a Perfetto-loadable Chrome-trace JSON file and return its
-    path (load at ``ui.perfetto.dev`` or ``chrome://tracing``)."""
+    path (load at ``ui.perfetto.dev`` or ``chrome://tracing``).
+
+    ``extra_events``: pre-built Chrome-format rows appended verbatim
+    after the recorder's own — how request-ledger exemplar instants
+    (``mpit_tpu.obs.trace.exemplar_trace_events``) land on the same
+    rid-filterable lanes as the serve spans.
+    """
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     # ONE snapshot feeds both the events and the dropped count — two
@@ -120,7 +127,8 @@ def export_chrome_trace(
     # events recorded after its traceEvents were taken.
     snap = _require(recorder).snapshot()
     doc = {
-        "traceEvents": snapshot_trace_events(snap, pid=_default_pid()),
+        "traceEvents": snapshot_trace_events(snap, pid=_default_pid())
+        + list(extra_events or ()),
         "displayTimeUnit": "ms",
     }
     dropped = snap["dropped"]

@@ -111,6 +111,7 @@ class Sentinel:
         starvation_ratio: float = 0.5,
         max_anomalies: int = 64,
         phases: tuple[str, ...] | None = None,
+        on_note: "callable | None" = None,
     ):
         self.window = window
         self.warmup = max(2, warmup)
@@ -121,6 +122,13 @@ class Sentinel:
         self.starvation_ratio = starvation_ratio
         self.max_anomalies = max_anomalies
         self.phases = tuple(phases) if phases is not None else None
+        # Detection-time fan-out (ISSUE 16 satellite): called with every
+        # emitted record — built-in detections AND external note()s —
+        # so a request-lifecycle ledger can pin the in-flight set the
+        # moment a breach/anomaly fires (the instant and the requests
+        # that caused it are otherwise unjoinable). The serve scheduler
+        # chains onto this; it is a public, reassignable attribute.
+        self.on_note = on_note
         self._detectors: dict[str, _Detector] = {}
         self._anomalies: list[dict] = []
         self._counts: dict[str, int] = {}
@@ -136,6 +144,8 @@ class Sentinel:
             self._anomalies.append(record)
         # Structured instant: lands in the trace next to the guilty span.
         _obs.instant("anomaly", **record)
+        if self.on_note is not None:
+            self.on_note(record)
 
     def note(self, kind: str, metric: str, step: int, **extra) -> None:
         """Record an EXTERNALLY detected anomaly into this sentinel's

@@ -742,7 +742,10 @@ class Engine:
         # per-request recompiles" claim as a runtime-guarded metric.
         # Every jitted-step invocation below routes through the watch;
         # growth past `expected` is an unexpected recompile (instant +
-        # sentinel note — the Server attaches its sentinel).
+        # sentinel note — the Server attaches its sentinel; with a
+        # request ledger wired, that note also pins the in-flight
+        # request set, so a mid-serve recompile stall is joinable to
+        # exactly the requests whose latency it poisoned — ISSUE 16).
         # Speculation keeps the discipline with ONE extra compile: the
         # decode tick splits into spec_draft + spec_verify (the plain
         # decode step is never built).

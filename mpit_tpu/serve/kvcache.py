@@ -269,6 +269,14 @@ class AdmitPlan:
     shared_tokens: int
     pages: tuple
 
+    @property
+    def pages_granted(self) -> int:
+        """Total pages this admission mapped (fresh + shared + COW
+        reserve) — the slot-bind cost figure the request ledger records
+        (ISSUE 16): a why-slow trace needs the grant size without
+        holding the page tuple alive in every retained exemplar."""
+        return len(self.pages)
+
 
 def _prefix_hashes(tokens) -> list:
     """Rolling polynomial hash of every prefix: ``out[i]`` covers

@@ -258,6 +258,15 @@ class SchedulingPolicy:
         self.preemptions = 0
         self.resumes = 0
         self.shed_admission = 0
+        # The most recent admission verdict WITH the projection inputs
+        # that produced it (ISSUE 16): the scheduler copies this into
+        # the request's ledger so a "the projection lied" forensic can
+        # replay the arithmetic months later. Overwritten per verdict —
+        # the ledger is the durable store, not this field.
+        self.last_admission: dict = {"verdict": "none"}
+        # The queued head on whose behalf wants_preemption() last said
+        # yes — the DISPLACING rid the victim's park event records.
+        self.last_preemption_for: str = ""
         # (rid, tier, tenant) in SUCCESSFUL admit order — a failed
         # admission's restore() pops its entry back off. Bounded: a
         # long-running server must not spend memory on a diagnostic
@@ -386,19 +395,37 @@ class SchedulingPolicy:
         return None
 
     # -- admission (shed vs queue) -------------------------------------------
+    # The verdict is ledgered at the SUBMIT seam (the scheduler emits
+    # the admission event from last_admission right after this call —
+    # emitting here too would double-count every verdict).
+    # analysis: allow(ledger-seam)
     def should_shed(self, req) -> bool:
         """True when queueing ``req`` would already breach its TTFT
         target by projection — shedding now beats a guaranteed miss
         later. Requests without a target (``ttft_target_s <= 0``) are
-        never admission-shed; cold windows abstain (admit)."""
+        never admission-shed; cold windows abstain (admit). Every call
+        records its verdict + projection inputs in ``last_admission``."""
+        depth = self.depth_at_or_above(req.priority)
+        verdict = {
+            "queue_depth": depth,
+            "ttft_target_s": req.ttft_target_s,
+            "admission_factor": self.cfg.admission_factor,
+            "proj_ttft_s": None,
+        }
+        self.last_admission = verdict
         if not self.cfg.admission or req.ttft_target_s <= 0:
+            verdict["verdict"] = (
+                "no_target" if self.cfg.admission else "disabled"
+            )
             return False
-        proj = self.projector.projected_ttft_s(
-            self.depth_at_or_above(req.priority)
-        )
+        proj = self.projector.projected_ttft_s(depth)
         if proj is None:
+            verdict["verdict"] = "abstain_cold"
             return False
-        return proj > self.cfg.admission_factor * req.ttft_target_s
+        verdict["proj_ttft_s"] = proj
+        shed = proj > self.cfg.admission_factor * req.ttft_target_s
+        verdict["verdict"] = "shed" if shed else "admit"
+        return shed
 
     # -- preemption ----------------------------------------------------------
     def wants_preemption(self, now: float):
@@ -423,6 +450,9 @@ class SchedulingPolicy:
                 return None
             waited = now - head.submit_t
             if waited + proj > head.req.ttft_target_s:
+                # The head this eviction serves — the victim's ledger
+                # park event names it (the DISPLACING rid, ISSUE 16).
+                self.last_preemption_for = head.req.rid
                 return priority
             return None
         return None

@@ -267,10 +267,30 @@ class Server:
     """
 
     def __init__(self, engine, *, sentinel=None, stream=None, slo=None,
-                 max_queue=None, policy=None):
+                 max_queue=None, policy=None, ledger=None):
         self.engine = engine
         self.sentinel = sentinel
         self.policy = policy
+        # Request lifecycle ledger (ISSUE 16): per-request causal events
+        # at every decision seam, tail-exemplar retention, why-slow
+        # attribution. ``None`` skips even the guard-site calls — the
+        # ledger-disabled arm of the overhead acceptance bar.
+        self._ledger = ledger
+        if ledger is not None and sentinel is not None:
+            # Breach/anomaly joinability (ISSUE 16 satellite): the
+            # sentinel's note fan-out pins the in-flight request set at
+            # detection time. Chain, don't clobber — a caller-installed
+            # callback keeps firing.
+            prev = sentinel.on_note
+
+            def _pin(record, _prev=prev, _ledger=ledger):
+                if _prev is not None:
+                    _prev(record)
+                _ledger.pin_inflight(
+                    record.get("kind", "anomaly"), step=record.get("step")
+                )
+
+            sentinel.on_note = _pin
         if policy is not None and stream is None:
             # The policy's projected-TTFT estimator reads rolling
             # prefill/decode tick windows — when the caller didn't wire
@@ -443,6 +463,14 @@ class Server:
             # SLO is shed/arrivals, so both sides of the ratio must see
             # every request that showed up.
             self.stream.inc("serve_arrivals")
+        if self._ledger is not None:
+            # The ledger opens at intake (post-validation): a SHED
+            # request still gets its enqueue + verdict events — the
+            # verdict is exactly what why-slow forensics needs.
+            self._ledger.begin(
+                req.rid, priority=req.priority, tenant=req.tenant,
+                prompt_len=len(req.prompt), max_new=req.max_new_tokens,
+            )
         # Two distinct shed causes (ISSUE 12 satellite) — bounded intake
         # vs the policy's projected-TTFT verdict — kept apart in the
         # cause-suffixed counters/instants/stats so breach forensics can
@@ -452,20 +480,41 @@ class Server:
         cause = None
         if self.max_queue is not None and self._qdepth() >= self.max_queue:
             cause = "queue_full"
-        elif self.policy is not None and self.policy.should_shed(req):
-            cause = "admission"
-            self.policy.shed_admission += 1
+        elif self.policy is not None:
+            if self.policy.should_shed(req):
+                cause = "admission"
+                self.policy.shed_admission += 1
+            if self._ledger is not None:
+                # The admission verdict WITH the projection inputs that
+                # produced it (ISSUE 16 tentpole) — the policy records
+                # them in ``last_admission`` precisely so a later "the
+                # projection lied" forensic can replay the arithmetic.
+                self._ledger.event(
+                    req.rid, "admission", **self.policy.last_admission
+                )
+        # Stable reason names for the instant/ledger (ISSUE 16
+        # satellite): intake bound vs projection verdict, spelled out.
+        reason = {
+            "queue_full": "queue_full",
+            "admission": "admission_projection",
+        }.get(cause)
         if cause is not None:
             self.shed.append(req)
             self.shed_causes[cause] = self.shed_causes.get(cause, 0) + 1
             obs.counter("serve_shed")
             obs.counter(f"serve_shed_{cause}")
-            obs.instant("request_shed", cause=cause,
+            obs.instant("request_shed", cause=cause, reason=reason,
                         queue_depth=self._qdepth(),
                         **self._span_attrs(req))
             if self.stream is not None:
                 self.stream.inc("serve_shed")
                 self.stream.inc(f"serve_shed_{cause}")
+            if self._ledger is not None:
+                self._ledger.event(
+                    req.rid, "shed", reason=reason,
+                    queue_depth=self._qdepth(),
+                )
+                self._ledger.retire(req.rid, status="shed", reason=reason)
             return False
         self._enqueue(_Live(req, time.perf_counter()))
         return True
@@ -570,6 +619,13 @@ class Server:
             live.base = min(plan.shared_tokens, len(feed) - 1)
             self._temp[slot] = live.req.temperature
             self._topk[slot] = live.req.top_k
+            if self._ledger is not None:
+                self._ledger.event(
+                    live.req.rid, "slot_bind", slot=slot, tick=self.tick,
+                    resumed=bool(live.tokens),
+                    shared_tokens=plan.shared_tokens,
+                    pages=plan.pages_granted,
+                )
             if live.tokens:
                 # Resumed after a preemption: queue_wait/TTFT were
                 # already delivered in the first stint — re-recording
@@ -579,6 +635,11 @@ class Server:
                     "request_resumed", generated=len(live.tokens),
                     **self._span_attrs(live.req),
                 )
+                if self._ledger is not None:
+                    self._ledger.event(
+                        live.req.rid, "preempt_resume", slot=slot,
+                        tick=self.tick, generated=len(live.tokens),
+                    )
             else:
                 obs.span_at(
                     "queue_wait", live.submit_t, now,
@@ -628,6 +689,14 @@ class Server:
         live.base = 0
         live.floor = 0
         obs.counter("serve_preemptions")
+        # The displacing rid (ISSUE 16): the head whose projected TTFT
+        # miss justified this eviction — recorded by wants_preemption,
+        # "" when the park came from a direct _preempt call.
+        for_rid = (
+            getattr(self.policy, "last_preemption_for", "") or ""
+            if for_tier is not None
+            else ""
+        )
         obs.instant(
             "request_preempted",
             tier=live.req.priority,
@@ -637,6 +706,14 @@ class Server:
             pages_unshared=shared,
             **self._span_attrs(live.req),
         )
+        if self._ledger is not None:
+            self._ledger.event(
+                live.req.rid, "preempt_park", tick=self.tick,
+                tier=live.req.priority,
+                for_tier=for_tier if for_tier is not None else -1,
+                for_rid=for_rid, generated=len(live.tokens),
+                pages_freed=owned,
+            )
         if self.stream is not None:
             self.stream.inc("serve_preemptions")
         self.policy.preemptions += 1
@@ -673,6 +750,11 @@ class Server:
                 if pair is not None:
                     eng.copy_page(*pair)
                     obs.counter("kv_cow_copies")
+                    if self._ledger is not None:
+                        self._ledger.event(
+                            live.req.rid, "cow_copy", tick=self.tick,
+                            src=pair[0], dst=pair[1], phase="prefill",
+                        )
             tokens[slot, :n] = p[live.base : live.base + n]
             base[slot] = live.base
             chunk_lens[slot] = n
@@ -699,6 +781,17 @@ class Server:
         if self.stream is not None:
             # The policy projector's per-chunk cost basis (ISSUE 12).
             self.stream.observe("prefill_tick", t_first - now)
+        if self._ledger is not None:
+            # One event per slot that actually advanced — the chunk
+            # length and the tick wall feed prefill_compute_s in the
+            # why-slow attribution.
+            for slot, live in self.prefilling.items():
+                n = int(chunk_lens[slot])
+                if n:
+                    self._ledger.event(
+                        live.req.rid, "prefill_chunk", tick=self.tick,
+                        chunk=n, dur_s=t_first - now, t=t_first,
+                    )
         for slot in self.prefilling:
             self.prefilling[slot].base += int(chunk_lens[slot])
         for slot, live in finishing:
@@ -759,6 +852,11 @@ class Server:
             admit[slot] = True
             self._temp[slot] = live.req.temperature
             self._topk[slot] = live.req.top_k
+            if self._ledger is not None:
+                self._ledger.event(
+                    live.req.rid, "slot_bind", slot=slot, tick=self.tick,
+                    resumed=False, t=now,
+                )
             obs.span_at(
                 "queue_wait", live.submit_t, now,
                 **self._span_attrs(live.req),
@@ -786,6 +884,15 @@ class Server:
             )
         if self.stream is not None:
             self.stream.observe("prefill_tick", t_first - now)
+        if self._ledger is not None:
+            # Dense prefill is one whole-prompt chunk; the shared batch
+            # wall is each admitted request's prefill-compute share.
+            for slot, live in batch:
+                self._ledger.event(
+                    live.req.rid, "prefill_chunk", tick=self.tick,
+                    chunk=len(live.req.prompt), dur_s=t_first - now,
+                    t=t_first,
+                )
         for slot, live in batch:
             live.first_token_t = t_first
             live.tokens = [int(first[slot])]
@@ -826,6 +933,28 @@ class Server:
         if self.stream is not None:
             self.stream.observe("request_latency", now - live.submit_t)
             self.stream.inc("serve_completed")
+        truncated = (
+            full and tok != req.eos_id and len(live.tokens) < req.max_new_tokens
+        )
+        if self._ledger is not None:
+            reason = (
+                "eos"
+                if req.eos_id is not None and tok == req.eos_id
+                else (
+                    "max_tokens"
+                    if len(live.tokens) >= req.max_new_tokens
+                    else "cache_full"
+                )
+            )
+            self._ledger.event(
+                req.rid, "retire", tick=self.tick, reason=reason,
+                generated=len(live.tokens), t=now,
+            )
+            self._ledger.retire(
+                req.rid, t=now,
+                status="truncated" if truncated else "completed",
+                reason=reason,
+            )
         self.completed.append(
             Completed(
                 rid=req.rid,
@@ -834,9 +963,7 @@ class Server:
                 submit_t=live.submit_t,
                 first_token_t=live.first_token_t,
                 finish_t=now,
-                truncated=full
-                and tok != req.eos_id
-                and len(live.tokens) < req.max_new_tokens,
+                truncated=truncated,
                 tenant=req.tenant,
             )
         )
@@ -879,6 +1006,11 @@ class Server:
                     if pair is not None:
                         eng.copy_page(*pair)
                         obs.counter("kv_cow_copies")
+                        if self._ledger is not None:
+                            self._ledger.event(
+                                live.req.rid, "cow_copy", tick=self.tick,
+                                src=pair[0], dst=pair[1], phase="spec",
+                            )
         n_live = int(active.sum())
         rids = [live.req.rid for live in self.live.values()]
         t0 = time.perf_counter()
@@ -960,6 +1092,16 @@ class Server:
                 self._util_watch.observe(
                     "decode_hbm_gbps", self.tick, ach / (now - t1) / 1e9
                 )
+        if self._ledger is not None:
+            # Per-slot draft/accept accounting (ISSUE 16): the rollback
+            # streak a spec-heavy slow request suffered is only visible
+            # per request, never in the aggregate acceptance rate.
+            for slot, live in self.live.items():
+                self._ledger.event(
+                    live.req.rid, "spec_tick", tick=self.tick,
+                    dur_s=now - t0, drafted=k, t=now,
+                    accepted=int(n_acc[slot]), emitted=int(n_emit[slot]),
+                )
         for slot in list(self.live):
             n = int(n_emit[slot])
             self.live[slot].tokens.extend(
@@ -987,6 +1129,11 @@ class Server:
                 if pair is not None:
                     self.engine.copy_page(*pair)
                     obs.counter("kv_cow_copies")
+                    if self._ledger is not None:
+                        self._ledger.event(
+                            live.req.rid, "cow_copy", tick=self.tick,
+                            src=pair[0], dst=pair[1], phase="decode",
+                        )
         t0 = time.perf_counter()
         with obs.span(
             "decode", active=int(active.sum()), attention=self._attn_mode,
@@ -1003,6 +1150,16 @@ class Server:
             self.stream.inc("serve_tokens", float(active.sum()))
             # The policy projector's decode-tick term (ISSUE 12).
             self.stream.observe("decode_tick", now - t0)
+        if self._ledger is not None:
+            # Decode-tick MEMBERSHIP: the tick wall is every resident
+            # request's latency cost (the tick is shared; the slot is
+            # occupied for all of it) — decode_compute_share_s.
+            n_live = int(active.sum())
+            for live in self.live.values():
+                self._ledger.event(
+                    live.req.rid, "decode_tick", tick=self.tick,
+                    dur_s=now - t0, active=n_live, t=now,
+                )
         lens = np.asarray(
             [live.cache_fill() for live in self.live.values()]
         )
@@ -1115,7 +1272,20 @@ class Server:
         if self.live:
             self._decode_tick()
         if self.slo is not None:
-            self.slo.evaluate(tick=self.tick)
+            transitions = self.slo.evaluate(tick=self.tick)
+            if (
+                self._ledger is not None
+                and getattr(self.slo, "sentinel", None) is None
+            ):
+                # No sentinel wired: pin the in-flight set from the
+                # monitor's returned transitions directly (with a
+                # sentinel the on_note chain installed in __init__
+                # already did it — never both, or breaches double-pin).
+                for tr in transitions:
+                    if tr.get("event") == "slo_breach":
+                        self._ledger.pin_inflight(
+                            "slo_breach", step=self.tick
+                        )
         self.tick += 1
 
     def run(self, *, max_ticks: int = 1_000_000) -> list[Completed]:
@@ -1294,15 +1464,30 @@ class Server:
                 kv_cow_copies=alloc.cow_copies,
             )
         if self.shed:
-            out["requests_shed"] = len(self.shed)
-            # Cause split (ISSUE 12 satellite): bounded intake vs the
-            # projected-TTFT admission verdict, never conflated.
+            # Cause breakdown (ISSUE 16 satellite): ``requests_shed``
+            # is a dict — total plus the two named reasons (bounded
+            # intake vs the projected-TTFT admission verdict), zeros
+            # included so a reader never KeyErrors on the quiet cause.
+            # The flat ``requests_shed_<cause>`` keys stay for the
+            # bench record line and older readers.
+            out["requests_shed"] = {
+                "total": len(self.shed),
+                "shed_queue_full": self.shed_causes.get("queue_full", 0),
+                "shed_admission_projection": self.shed_causes.get(
+                    "admission", 0
+                ),
+            }
             for cause, n in sorted(self.shed_causes.items()):
                 out[f"requests_shed_{cause}"] = n
         if self.policy is not None:
             pol = self.policy.stats()
             out["preemptions"] = pol["preemptions"]
             out["policy"] = pol
+        if self._ledger is not None:
+            # Why-slow surfacing (ISSUE 16): the retained tail
+            # exemplars, worst first, plus the ledger's aggregate view.
+            out["exemplars"] = self._ledger.exemplars()
+            out["ledger"] = self._ledger.stats()
         tenants = self._tenant_rollup()
         if tenants:
             out["tenants"] = tenants

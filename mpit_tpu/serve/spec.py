@@ -119,7 +119,11 @@ def accept_emit(drafted, greedy, p_x, q_x, u, repl, greedy_row, budget, eos):
     junk K/V (rejected drafts) that the mask hides and the next append
     overwrites. ``n_emit >= 1`` always (the replacement/bonus token is
     this tick's guaranteed token, speculation never emits less than
-    plain decode).
+    plain decode). The per-slot ``n_acc``/``n_emit`` split is also the
+    request-ledger observable (ISSUE 16): the scheduler's ``spec_tick``
+    events record them per request per tick, so a rollback STREAK — the
+    per-request pathology the aggregate acceptance rate averages away —
+    is visible in a why-slow exemplar lifeline.
     """
     s, k = drafted.shape
     acc_samp = u * q_x < p_x

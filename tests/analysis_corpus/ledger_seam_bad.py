@@ -1,0 +1,13 @@
+"""Corpus: ledger-seam fires exactly once — a marked decision seam that
+decides a request's fate (here: early retirement) without emitting a
+request-ledger event goes dark in why-slow forensics."""
+
+
+# analysis: ledger-seam
+def maybe_retire(server, slot, now):  # VIOLATION
+    live = server.live[slot]
+    if len(live.tokens) < live.req.max_new_tokens:
+        return
+    del server.live[slot]
+    server.free.append(slot)
+    server.completed.append((live.req.rid, now))

@@ -1,0 +1,26 @@
+"""Corpus false-positive guards for ledger-seam: a marked seam that
+emits through the guarded ledger idiom, a marked seam whose suppression
+names where the decision IS ledgered, and an unmarked helper that needs
+no ledger at all."""
+
+
+# analysis: ledger-seam
+def maybe_retire(server, slot, now):
+    live = server.live[slot]
+    if len(live.tokens) < live.req.max_new_tokens:
+        return
+    del server.live[slot]
+    server.free.append(slot)
+    if server._ledger is not None:  # guarded emit: fine
+        server._ledger.event(live.req.rid, "retire", reason="max_tokens")
+    server.completed.append((live.req.rid, now))
+
+
+# The verdict is ledgered by the caller at the submit seam.
+# analysis: ledger-seam
+def should_shed(policy, req):  # analysis: allow(ledger-seam)
+    return policy.projected_ttft(req) > req.ttft_target_s
+
+
+def tier_depths(server):  # unmarked helper, no decision: fine
+    return {t: len(q) for t, q in server.tiers.items()}

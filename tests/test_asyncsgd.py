@@ -88,6 +88,7 @@ class TestImagenet:
         assert out["steps"] == 4
         assert np.isfinite(out["final_loss"])
 
+    @pytest.mark.slow
     def test_parity_micro_runs(self):
         out = imagenet.main(
             ["--mode", "parity", "--nranks", "2", "--steps", "6",

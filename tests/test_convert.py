@@ -486,6 +486,7 @@ class TestElasticRescale:
         for a, b in zip(back.scalars, dense.scalars):
             np.testing.assert_array_equal(a, b)
 
+    @pytest.mark.slow
     def test_8_to_4_device_trajectory_parity(self, tmp_path):
         from mpit_tpu.train import load_dense, save_dense
         from mpit_tpu.train.step import make_train_step

@@ -124,6 +124,7 @@ class TestScaleShiftBatchNorm:
             mut_ref["batch_stats"],
         )
 
+    @pytest.mark.slow
     def test_resnet_swap_is_numerically_consistent(self):
         """ResNet-50 forward with the scale-shift BN vs the flax oracle,
         f32 end to end: same logits up to reduction noise."""

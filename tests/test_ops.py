@@ -226,6 +226,7 @@ class TestFlashBlockAndMerge:
             np.asarray(got0), np.asarray(full[:, :64]), rtol=3e-5, atol=3e-5
         )
 
+    @pytest.mark.slow
     def test_block_lse_gradient_path(self):
         """d/dq of a merged pair must match full attention — exercises the
         lse cotangent fold (delta − g_lse) in the Flash-2 backward."""
@@ -315,6 +316,7 @@ class TestFusedLMHead:
             rtol=0.05, atol=0.05,
         )
 
+    @pytest.mark.slow
     def test_gpt2_targets_path_matches_logits_path(self):
         """GPT2(..., targets=) must agree with the materialized-logits loss."""
         from mpit_tpu.models import GPT2, GPT2Config

@@ -760,6 +760,7 @@ class TestPipelineZero1:
         )
         return world, split, init_fn, step_fn
 
+    @pytest.mark.slow
     def test_matches_unsharded_trajectory(self):
         from mpit_tpu.data import SyntheticLM, shard_batch
 
@@ -846,6 +847,7 @@ class Test1F1BSchedule:
         )
         return world, split, init_fn, step_fn
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("zero1", [False, True])
     def test_matches_gpipe_trajectory(self, zero1):
         """1F1B's hand-rolled backward must track the AD oracle exactly:

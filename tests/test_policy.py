@@ -225,18 +225,31 @@ class TestShedCauses:
         assert reg.counter_total("serve_shed_queue_full") == 1.0
         server.run()
         stats = server.stats()
-        assert stats["requests_shed"] == 2
+        # The reason breakdown (ISSUE 16 satellite): a dict with the
+        # total and both named reasons, zeros never omitted — plus the
+        # flat legacy keys the bench record line reads.
+        assert stats["requests_shed"] == {
+            "total": 2,
+            "shed_queue_full": 1,
+            "shed_admission_projection": 1,
+        }
         assert stats["requests_shed_admission"] == 1
         assert stats["requests_shed_queue_full"] == 1
-        # The instants carry the cause for breach forensics.
-        causes = sorted(
-            attrs["cause"]
+        # The instants carry the cause AND the stable reason name for
+        # breach forensics.
+        shed_instants = [
+            attrs
             for kind, name, _t0, _dur, _tid, attrs in rec.snapshot()[
                 "events"
             ]
             if kind == "i" and name == "request_shed"
-        )
-        assert causes == ["admission", "queue_full"]
+        ]
+        assert sorted(a["cause"] for a in shed_instants) == [
+            "admission", "queue_full",
+        ]
+        assert sorted(a["reason"] for a in shed_instants) == [
+            "admission_projection", "queue_full",
+        ]
 
     def test_admission_abstains_on_cold_windows(self, params):
         """No evidence, no shedding: a cold projector admits even a
@@ -526,6 +539,7 @@ class TestPolicyTelemetry:
         assert tn["t0"]["ttft_p95_s"] > 0
         assert tn["t1"] == {"completed": 0, "shed": 1}
 
+    @pytest.mark.slow
     def test_cli_policy_smoke(self):
         from mpit_tpu.serve.__main__ import main
 

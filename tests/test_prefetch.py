@@ -130,6 +130,7 @@ class TestExceptions:
 
 
 class TestClose:
+    @pytest.mark.slow
     @pytest.mark.parametrize("workers", WORKERS)
     def test_close_while_consumer_blocked(self, world8, workers):
         """close() from another thread unblocks a consumer stuck in

@@ -151,6 +151,7 @@ class TestCollectiveModel:
 
 
 class TestTraceIntegration:
+    @pytest.mark.slow
     def test_app_profile_dir_writes_trace(self, tmp_path):
         from mpit_tpu.asyncsgd import mnist
 

@@ -615,6 +615,7 @@ class TestSpecValidation:
 
 
 class TestSpecCLI:
+    @pytest.mark.slow
     def test_cli_spec_smoke(self):
         """End to end through ``python -m mpit_tpu.serve`` with the
         self-speculation draft: spec telemetry lands in the JSON."""
