@@ -1199,6 +1199,69 @@ def _speculative_block(
     }
 
 
+def _train_tiny_lm(mcfg, batch, train_steps: int, seed: int):
+    """Memorize ``batch`` on a fresh tiny GPT-2 — the trained-checkpoint
+    regime the quantized-cache/weights quality gates run in (a random
+    init would make every agreement gate vacuous). Shared by the
+    ISSUE 15 KV block and the ISSUE 17 weights block so the two
+    batteries gate the same kind of checkpoint. Returns
+    ``(params, final_loss)``."""
+    import optax
+
+    from mpit_tpu.models import GPT2
+    from mpit_tpu.opt.goo import goo_adam
+
+    model = GPT2(mcfg)
+    params = jax.jit(model.init)(
+        jax.random.key(seed), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    opt = goo_adam(3e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(
+            lambda p: GPT2.fused_loss_fn(model, p, batch)
+        )(params)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state, loss
+
+    loss = None
+    for _ in range(train_steps):
+        params, state, loss = step(params, state)
+    return params, float(loss)
+
+
+def _greedy_stream_run(engine, rec, stream_toks, slots, prompt_len,
+                       max_new):
+    """One seeded greedy trace: prompts are prefixes of the memorized
+    stream (mild length skew), one warm + measured run. Returns
+    ``(stats, decode_tokens_per_sec, {rid: tokens})`` — decode tok/s
+    from the recorder's decode-phase seconds when available (whole-run
+    wall otherwise)."""
+    from mpit_tpu.serve import Request, Server, warm_engine
+
+    warm_engine(engine)
+    server = Server(engine)
+    for i in range(slots):
+        plen = prompt_len - (i % 3)
+        server.submit(Request(
+            rid=i, prompt=stream_toks[:plen], max_new_tokens=max_new,
+        ))
+    n0 = rec.event_count() if rec else 0
+    t0 = time.perf_counter()
+    server.run()
+    wall = time.perf_counter() - t0
+    st = server.stats()
+    dtok = st["generated_tokens"] - st["requests_completed"]
+    ds = wall
+    if rec is not None:
+        ph = rec.summary(since=n0)["phases"]
+        ds = ph.get("decode", {}).get("total_s", wall)
+    outs = {c.rid: c.tokens for c in server.completed}
+    return st, (dtok / ds if ds else None), outs
+
+
 def _quantized_kv_block(train_steps: int = 300, page_size: int = 16):
     """Quantized int8 KV cache A/B + capacity sweep + quality gates
     (ISSUE 15). One head_dim-64 config (the GPT-2 head geometry — the
@@ -1229,11 +1292,9 @@ def _quantized_kv_block(train_steps: int = 300, page_size: int = 16):
       unquantized; the acceptance delta is the recorded gate.
     """
     import numpy as np
-    import optax
 
     from mpit_tpu import obs
     from mpit_tpu.models import GPT2, GPT2Config
-    from mpit_tpu.opt.goo import goo_adam
     from mpit_tpu.serve import (
         Engine,
         Request,
@@ -1253,51 +1314,12 @@ def _quantized_kv_block(train_steps: int = 300, page_size: int = 16):
     stream_toks = rng.randint(0, cfg.vocab_size, size=160).tolist()
     batch = jnp.asarray([stream_toks[:129]], jnp.int32)
 
-    def _train(mcfg, seed):
-        model = GPT2(mcfg)
-        params = jax.jit(model.init)(
-            jax.random.key(seed), jnp.zeros((1, 8), jnp.int32)
-        )["params"]
-        opt = goo_adam(3e-3)
-        state = opt.init(params)
-
-        @jax.jit
-        def step(params, state):
-            loss, grads = jax.value_and_grad(
-                lambda p: GPT2.fused_loss_fn(model, p, batch)
-            )(params)
-            updates, state = opt.update(grads, state, params)
-            return optax.apply_updates(params, updates), state, loss
-
-        loss = None
-        for _ in range(train_steps):
-            params, state, loss = step(params, state)
-        return params, float(loss)
-
     rec = obs.get_recorder()
 
     def _stream_run(engine):
-        """The one seeded trace: prompts are prefixes of the memorized
-        stream (mild length skew), greedy, one warm + measured run."""
-        warm_engine(engine)
-        server = Server(engine)
-        for i in range(slots):
-            plen = prompt_len - (i % 3)
-            server.submit(Request(
-                rid=i, prompt=stream_toks[:plen], max_new_tokens=max_new,
-            ))
-        n0 = rec.event_count() if rec else 0
-        t0 = time.perf_counter()
-        server.run()
-        wall = time.perf_counter() - t0
-        st = server.stats()
-        dtok = st["generated_tokens"] - st["requests_completed"]
-        ds = wall
-        if rec is not None:
-            ph = rec.summary(since=n0)["phases"]
-            ds = ph.get("decode", {}).get("total_s", wall)
-        outs = {c.rid: c.tokens for c in server.completed}
-        return st, (dtok / ds if ds else None), outs
+        return _greedy_stream_run(
+            engine, rec, stream_toks, slots, prompt_len, max_new
+        )
 
     def _paged(params, kv_dtype, pages, n_slots=slots):
         return Engine(
@@ -1307,7 +1329,7 @@ def _quantized_kv_block(train_steps: int = 300, page_size: int = 16):
         )
 
     with obs.span("quantized_kv_ab"):
-        tparams, t_loss = _train(cfg, seed=5)
+        tparams, t_loss = _train_tiny_lm(cfg, batch, train_steps, seed=5)
 
         # -- A/B at identical geometry --------------------------------------
         pages_ab = slots * (max_len // page_size)
@@ -1514,6 +1536,297 @@ def _quantized_kv_block(train_steps: int = 300, page_size: int = 16):
         "speculative_neutrality": spec,
         "q8_capacity_ratio": capacity["q8_capacity_ratio"],
         "q8_kv_sweep_ratio": ab["q8_kv_sweep_ratio_vs_bf16"],
+    }
+
+
+def _quantized_weights_block(train_steps: int = 300, page_size: int = 16):
+    """Quantized int8 weight store A/B + capacity + quality gates
+    (ISSUE 17). The KV block's honesty note is this block's premise: at
+    serving batch sizes the PARAM read dominates the decode tick
+    (``q8_total_bytes_ratio_vs_bf16`` ≈ 0.92 — the cache is the
+    sliver), so the weights are where the bytes are. Four sub-blocks on
+    one trained checkpoint:
+
+    - ``ab``: the SAME seeded stream through identical dense engines at
+      weights_dtype f32 vs int8 — measured decode tokens/s (CPU wall,
+      platform-labeled, never a chip claim) plus the MODELED whole-tick
+      decode-bytes ratio at the stream's lengths (``q8w_bytes_ratio``,
+      the record-line headline: param read + KV sweep, each at its
+      actual wire dtype — the ratio credits quantization with exactly
+      the term it shrinks, diluted by the sweep it does not touch) and
+      the param-read / wire ratios from the shared
+      ``weight_wire_bytes`` sizing rule.
+    - ``capacity``: the SAME total HBM budget (param store + KV pool)
+      spent with f32 vs int8 weights — freed param bytes convert to KV
+      pages; measured peak concurrency both ways. On this tiny geometry
+      the int8 page grant is slot-capped; the uncapped modeled grant is
+      recorded next to the granted one — neither fabricated.
+    - ``quality``: gates on the TRAINED checkpoint — max per-token
+      logit error of the int8-weight forward vs the f32-weight oracle
+      through the SAME f32 cache (+ anti-vacuity: the error must be
+      nonzero, the lossy path must actually execute), and greedy
+      agreement vs the f32-weight engine over the stream.
+    - ``speculative``: acceptance neutrality with int8 weights on BOTH
+      draft and target (the engine quantizes the draft store too) vs
+      the unquantized pair; the acceptance delta is the recorded gate.
+    """
+    import numpy as np
+
+    from mpit_tpu import obs
+    from mpit_tpu.models import GPT2, GPT2Config
+    from mpit_tpu.serve import (
+        Engine,
+        Request,
+        Server,
+        alloc_cache,
+        draft_from_target,
+        kv_wire_bytes_per_row,
+        params_wire_bytes,
+        quantize_gpt2_params,
+        warm_engine,
+    )
+
+    cfg = GPT2Config(
+        vocab_size=256, max_seq_len=192, num_layers=2, num_heads=4,
+        d_model=256, head_dtype=jnp.bfloat16,
+    )
+    slots, prompt_len, max_new, max_len = 8, 64, 16, 96
+    rng = np.random.RandomState(31)
+    stream_toks = rng.randint(0, cfg.vocab_size, size=160).tolist()
+    batch = jnp.asarray([stream_toks[:129]], jnp.int32)
+    rec = obs.get_recorder()
+
+    def _stream_run(engine):
+        return _greedy_stream_run(
+            engine, rec, stream_toks, slots, prompt_len, max_new
+        )
+
+    with obs.span("quantized_weights_ab"):
+        tparams, t_loss = _train_tiny_lm(cfg, batch, train_steps, seed=7)
+        # The shared sizing rule (``weight_wire_bytes`` under
+        # ``params_wire_bytes``): what each param store occupies on the
+        # wire — int8 payload + per-row f32 scales vs dense f32.
+        pw = {
+            "f32": params_wire_bytes(tparams),
+            "int8": params_wire_bytes(quantize_gpt2_params(tparams)),
+        }
+
+        # -- A/B at identical geometry --------------------------------------
+        ab = {}
+        engines = {}
+        for dt in ("f32", "int8"):
+            eng = Engine(
+                cfg, tparams, slots=slots, max_len=max_len,
+                prefill_len=prompt_len, weights_dtype=dt,
+            )
+            st, tps, outs = _stream_run(eng)
+            engines[dt] = (eng, outs)
+            ab[dt] = {
+                "decode_tokens_per_sec": round(tps, 1) if tps else None,
+                "decode_hbm_bytes_modeled": st.get(
+                    "decode_hbm_bytes_modeled"
+                ),
+                "param_wire_bytes": pw[dt],
+            }
+        # Modeled bytes for one representative tick (all slots at their
+        # final fills — deterministic, every engine ran the same
+        # schedule): whole tick and the param read it contains.
+        lens = np.asarray(
+            [prompt_len - (i % 3) + max_new - 1 for i in range(slots)]
+        )
+        total = {
+            dt: engines[dt][0].decode_achieved_hbm_bytes(lens)
+            for dt in engines
+        }
+        kv_sweep = {
+            dt: engines[dt][0].decode_achieved_hbm_bytes(
+                lens, include_params=False
+            )
+            for dt in engines
+        }
+        param_read = {dt: total[dt] - kv_sweep[dt] for dt in engines}
+        ab["q8w_bytes_ratio"] = round(total["int8"] / total["f32"], 4)
+        ab["q8w_param_read_ratio"] = round(
+            param_read["int8"] / param_read["f32"], 4
+        )
+        ab["param_wire_ratio"] = round(pw["int8"] / pw["f32"], 4)
+        # The KV block's honesty note, inverted: how much of the f32
+        # tick the param read IS on this geometry — here the dominant
+        # term is the one being shrunk.
+        ab["param_share_of_f32_tick"] = round(
+            param_read["f32"] / total["f32"], 4
+        )
+
+        # -- capacity at a FIXED total HBM budget (params + pool) -----------
+        # The KV block holds the POOL budget fixed; here the budget
+        # covers the param store too — the bytes weight quantization
+        # frees are real HBM that converts to KV pages.
+        row = kv_wire_bytes_per_row(
+            cfg.num_heads, cfg.head_dim, jnp.bfloat16
+        )
+        page_bytes = 2 * cfg.num_layers * page_size * row  # K+V, all layers
+        pages_per_req = -(-(prompt_len + max_new - 1) // page_size)
+        pages_f32 = 3 * pages_per_req  # the f32 arm: 3 requests' worth
+        budget_bytes = pw["f32"] + pages_f32 * page_bytes
+        pages_int8_modeled = int(
+            (budget_bytes - pw["int8"]) // page_bytes
+        )
+        cap_slots, cap_requests = 12, 24
+        # The modeled grant dwarfs what the slot batch can touch on this
+        # tiny geometry (params >> pool) — grant what the slots can use
+        # and record BOTH numbers.
+        pages_int8 = min(pages_int8_modeled, cap_slots * pages_per_req)
+        crng = np.random.RandomState(37)
+        cap_reqs = [
+            Request(
+                rid=i,
+                prompt=crng.randint(
+                    0, cfg.vocab_size, size=prompt_len
+                ).tolist(),
+                max_new_tokens=max_new,
+            )
+            for i in range(cap_requests)
+        ]
+
+        def _capacity(weights_dtype, pages):
+            eng = Engine(
+                cfg, tparams, slots=cap_slots, max_len=max_len,
+                prefill_len=prompt_len, kv_pages=pages,
+                kv_page_size=page_size, kv_dtype="bf16",
+                weights_dtype=weights_dtype,
+            )
+            warm_engine(eng)
+            server = Server(eng)
+            for r in cap_reqs:
+                server.submit(r)
+            t0 = time.perf_counter()
+            server.run()
+            wall = time.perf_counter() - t0
+            st = server.stats()
+            dtok = st["generated_tokens"] - st["requests_completed"]
+            return {
+                "pages": pages,
+                "param_wire_bytes": pw[weights_dtype],
+                "max_concurrent": st["concurrency_peak"],
+                "pool_occupancy_peak": st["kv_pool_occupancy_peak"],
+                "decode_tokens_per_sec": (
+                    round(dtok / wall, 1) if wall else None
+                ),
+            }
+
+        cap_f32 = _capacity("f32", pages_f32)
+        cap_i8 = _capacity("int8", pages_int8)
+        capacity = {
+            "total_budget_bytes": int(budget_bytes),
+            "page_bytes": int(page_bytes),
+            "page_size": page_size,
+            "request_shape": {
+                "prompt_len": prompt_len, "max_new": max_new,
+                "pages_per_request": pages_per_req,
+                "requests": cap_requests, "slots": cap_slots,
+            },
+            "f32": cap_f32,
+            "int8": cap_i8,
+            "pages_int8_modeled": pages_int8_modeled,
+            "int8_pages_slot_capped": pages_int8 < pages_int8_modeled,
+            "q8w_capacity_ratio": round(
+                cap_i8["max_concurrent"]
+                / max(cap_f32["max_concurrent"], 1),
+                2,
+            ),
+        }
+
+        # -- quality gates on the trained checkpoint ------------------------
+        # Same f32 cache BOTH sides — only the weight store differs, so
+        # the delta is weight quantization and nothing else.
+        model = GPT2(cfg)
+        qparams = quantize_gpt2_params(tparams)
+        q_slots, q_len = 4, prompt_len
+        padded = np.zeros((q_slots, q_len), np.int32)
+        for i in range(q_slots):
+            padded[i, : q_len - i] = stream_toks[: q_len - i]
+        c_f = alloc_cache(cfg, slots=q_slots, max_len=q_len,
+                          dtype=jnp.float32)
+        c_q = alloc_cache(cfg, slots=q_slots, max_len=q_len,
+                          dtype=jnp.float32)
+        lf, _ = model.apply(
+            {"params": tparams}, jnp.asarray(padded),
+            cache=(c_f.k, c_f.v, c_f.lengths),
+        )
+        lq, _ = model.apply(
+            {"params": qparams}, jnp.asarray(padded),
+            cache=(c_q.k, c_q.v, c_q.lengths),
+        )
+        # Positional mask: row i holds q_len - i real tokens (a value
+        # mask would drop real positions holding token id 0).
+        mask = (
+            np.arange(q_len)[None, :]
+            < (q_len - np.arange(q_slots))[:, None]
+        )
+        delta = np.abs(np.asarray(lf, np.float32)
+                       - np.asarray(lq, np.float32))[mask]
+        f32_outs = engines["f32"][1]
+        i8_outs = engines["int8"][1]
+        same = sum(
+            t == r
+            for rid in f32_outs
+            for t, r in zip(i8_outs[rid], f32_outs[rid])
+        )
+        total_toks = sum(len(v) for v in f32_outs.values())
+        quality = {
+            "target_final_loss": round(t_loss, 4),
+            "logit_abs_err_max": round(float(delta.max()), 5),
+            "logit_abs_err_mean": round(float(delta.mean()), 6),
+            # Anti-vacuity: zero error would mean the quantized store
+            # never fed a matmul — the gates would be vacuously green.
+            "logit_err_nonzero": bool(delta.max() > 0),
+            "greedy_agreement_vs_f32": round(same / total_toks, 4),
+        }
+
+        # -- speculative acceptance neutrality ------------------------------
+        # int8 weights go on BOTH draft and target (the engine
+        # quantizes the draft store too) — acceptance compares two
+        # quantized models against each other, the deployed shape.
+        dparams, dcfg = draft_from_target(tparams, cfg, 1)
+        spec_acc = {}
+        for dt in ("f32", "int8"):
+            eng = Engine(
+                cfg, tparams, slots=slots, max_len=128,
+                prefill_len=prompt_len, spec_k=3,
+                draft_params=dparams, draft_cfg=dcfg,
+                weights_dtype=dt,
+            )
+            st, _tps, _outs = _stream_run(eng)
+            spec_acc[dt] = {
+                "draft_acceptance_rate": st.get("draft_acceptance_rate"),
+                "accepted_tokens_per_tick": st.get(
+                    "accepted_tokens_per_tick"
+                ),
+            }
+        a0 = spec_acc["f32"]["draft_acceptance_rate"]
+        a8 = spec_acc["int8"]["draft_acceptance_rate"]
+        spec = {
+            **spec_acc,
+            "acceptance_delta": (
+                round(a8 - a0, 4) if a0 is not None and a8 is not None
+                else None
+            ),
+        }
+
+    return {
+        "geometry": dict(
+            vocab=cfg.vocab_size, d_model=cfg.d_model,
+            num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+            head_dim=cfg.head_dim, slots=slots, max_len=max_len,
+            prompt_len=prompt_len, max_new=max_new,
+            page_size=page_size, train_steps=train_steps,
+        ),
+        "ab": ab,
+        "capacity": capacity,
+        "quality": quality,
+        "speculative_neutrality": spec,
+        "q8w_bytes_ratio": ab["q8w_bytes_ratio"],
+        "q8w_capacity_ratio": capacity["q8w_capacity_ratio"],
     }
 
 
@@ -1837,6 +2150,14 @@ def bench_gpt2_serve(
     out["quantized_kv"] = _quantized_kv_block()
     out["kv_dtype"] = engine.kv_dtype
     out["q8_capacity_ratio"] = out["quantized_kv"]["q8_capacity_ratio"]
+    # ISSUE 17: the quantized-WEIGHTS A/B + capacity + quality gates
+    # (trained checkpoint; the param read is the dominant tick term the
+    # KV block's honesty note pointed at). Block detail-only; the line
+    # carries the headline stream's weight wire dtype and the modeled
+    # int8-vs-f32 whole-tick decode-bytes ratio.
+    out["quantized_weights"] = _quantized_weights_block()
+    out["weights_dtype"] = engine.weights_dtype
+    out["q8w_bytes_ratio"] = out["quantized_weights"]["q8w_bytes_ratio"]
     # ISSUE 16: the request-ledger overhead A/B + forensics snapshot
     # (block detail-only; the line carries the aggregate-arm overhead
     # pct and the exemplar count proving tail capture ran).
@@ -2748,12 +3069,24 @@ _LINE_KEYS = {
     # the line) and kv_dtype (static engine config, pinned by tier-1 —
     # the q8 ratio already names the comparison) — both verbatim in
     # BENCH_DETAIL.json.
+    # weights_dtype + q8w_bytes_ratio (ISSUE 17): the headline stream's
+    # weight wire dtype (the param read DOMINATES the decode tick, so
+    # byte figures are uninterpretable without it) and the modeled
+    # int8-vs-f32 whole-tick decode-bytes ratio from the weights A/B.
+    # Paid for by demoting decode_attention (static engine config — the
+    # kernel-vs-reference resolution is pinned per-platform by tier-1's
+    # fallback tests and lands in BENCH_DETAIL.json verbatim, so
+    # ISSUE 5's attributability survives in the detail file) and
+    # exemplars_retained (its ≥1 pin lives in the artifact test —
+    # TestForensicsArtifact — and trace_overhead_pct keeps the ledger
+    # verdict on the line) — both verbatim in BENCH_DETAIL.json.
     "gpt2_serve": (
-        "decode_tokens_per_sec", "decode_attention",
+        "decode_tokens_per_sec",
         "accepted_tokens_per_tick",
         "max_concurrent_at_hbm",
         "q8_capacity_ratio",
-        "trace_overhead_pct", "exemplars_retained", "error",
+        "weights_dtype", "q8w_bytes_ratio",
+        "trace_overhead_pct", "error",
     ),
     # The SLO sweep's line is the headline triple only — the sustained
     # rate, the target that defines it, and the breach count proving the

@@ -379,6 +379,81 @@ def _contract_quantized_decode(ctx):
     )
 
 
+def _contract_quantized_weights(ctx):
+    """ISSUE 17: the int8 weight store's dequant stays PER-BLOCK inside
+    the blocked matmuls — no full dequantized f32 weight (qkv/proj/fc/
+    out kernel, wte / tied head) may materialize in any int8 engine
+    step's jaxpr. The contract shrinks the tile grain
+    (``quant_block_rows=16``, ``sample_block=16``) so a LEGITIMATE
+    dequantized tile can never collide with a pinned full-weight shape
+    on the tiny config (e.g. a 32-row head tile would equal the 32x32
+    proj kernel). Both hot traces are pinned: the plain decode step and
+    the speculative draft step (whose head runs INSIDE the hot tick —
+    the trace a whole-dequant shortcut would most plausibly sneak back
+    through). Anti-vacuity: the reference engine (the whole-dequant
+    parity oracle) DOES materialize the f32 qkv kernel."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from mpit_tpu.serve import Engine
+    from mpit_tpu.serve.weights import draft_from_target
+
+    cfg, params = ctx["model"]
+    cfg16 = dataclasses.replace(cfg, quant_block_rows=16)
+    slots, max_len = 2, 32
+    f32 = jnp.dtype(jnp.float32)
+    weights = (
+        (cfg.d_model, 3 * cfg.d_model),  # qkv kernel
+        (cfg.d_model, cfg.d_model),      # proj kernel
+        (cfg.d_model, cfg.ff_dim),       # fc kernel
+        (cfg.ff_dim, cfg.d_model),       # out kernel
+        (cfg.vocab_size, cfg.d_model),   # wte / tied head
+    )
+
+    def decode_jaxpr(eng):
+        return jax.make_jaxpr(eng._decode_step)(
+            eng.params, eng.cache, eng.last_token,
+            jnp.ones((slots,), bool), jax.random.key(0),
+            jnp.zeros((slots,), jnp.float32),
+            jnp.zeros((slots,), jnp.int32),
+        )
+
+    eng = Engine(
+        cfg16, params, slots=slots, max_len=max_len, prefill_len=8,
+        decode_attention="interpret", sample_block=16, sample_k_cap=16,
+        weights_dtype="int8",
+    )
+    assert_no_intermediate(
+        decode_jaxpr(eng), *weights,
+        what="int8-weights decode step", dtype=f32,
+    )
+    dp, dcfg = draft_from_target(params, cfg16, 1)
+    spec = Engine(
+        cfg16, params, slots=slots, max_len=max_len, prefill_len=8,
+        decode_attention="interpret", sample_block=16, sample_k_cap=16,
+        spec_k=2, draft_params=dp, draft_cfg=dcfg, weights_dtype="int8",
+    )
+    jxd = jax.make_jaxpr(spec._spec_draft_step)(
+        spec.draft_params, spec.draft_cache, spec.last_token,
+        jnp.ones((slots,), bool), jax.random.key(0),
+        jnp.zeros((slots,), jnp.float32), jnp.zeros((slots,), jnp.int32),
+    )
+    assert_no_intermediate(
+        jxd, *weights, what="int8-weights spec_draft step", dtype=f32
+    )
+    ref = Engine(
+        cfg, params, slots=slots, max_len=max_len, prefill_len=8,
+        decode_attention="reference", weights_dtype="int8",
+    )
+    assert_intermediate(
+        decode_jaxpr(ref), (cfg.d_model, 3 * cfg.d_model),
+        what="int8-weights reference decode (whole-dequant oracle)",
+        dtype=f32,
+    )
+
+
 def _contract_lm_head_sample(ctx):
     """The blocked sampler never runs the full-width logits matmul."""
     import jax
@@ -467,6 +542,7 @@ CONTRACTS = {
     "decode-blocked": _contract_decode_blocked,
     "paged-decode-blocked": _contract_paged_decode_blocked,
     "quantized-decode": _contract_quantized_decode,
+    "quantized-weights": _contract_quantized_weights,
     "lm-head-sample": _contract_lm_head_sample,
     "lm-head-verify": _contract_lm_head_verify,
     "train-step-donation": _contract_train_step_donation,

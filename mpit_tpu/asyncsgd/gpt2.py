@@ -445,6 +445,9 @@ def main(argv: list[str] | None = None, **overrides) -> dict:
             stream_factory=lambda skip: runner.make_stream(
                 cfg, dataset, cfg.seq_len, skip=skip
             ),
+            dense_meta={
+                "num_heads": mcfg.num_heads, "tie_head": mcfg.tie_head
+            },
         )
         out.update(
             tier="shard_map+zero1",

@@ -247,6 +247,7 @@ def run_spmd(
     eval_batch: dict | None = None,
     stream_factory: Callable | None = None,
     val_sweep: Callable | None = None,
+    dense_meta: dict | None = None,
 ) -> dict:
     """Drive the jitted SPMD train step for ``cfg.steps`` steps.
 
@@ -270,6 +271,9 @@ def run_spmd(
         at the last step) the sweep's averaged metrics are logged as
         ``eval_*`` rows in the metrics JSONL — the accuracy curve the 58%
         top-1 north star is read from (BASELINE.json).
+      dense_meta: shape-underivable model geometry (``num_heads``,
+        ``tie_head``) recorded in the ``--save-dense`` npz so the serve
+        loader stops guessing head count (ISSUE 17).
     """
     world = mpit_tpu.init(cfg.mesh_shape())
     axis = "data"
@@ -452,7 +456,7 @@ def run_spmd(
         # can resume on a different mesh size via --resume-dense.
         from mpit_tpu.train import dense_from_dp, save_dense as _save_dense
 
-        _save_dense(cfg.save_dense, dense_from_dp(state))
+        _save_dense(cfg.save_dense, dense_from_dp(state), **(dense_meta or {}))
         logger.log(int(state.step), {"event": "dense_saved",
                                      "path": cfg.save_dense})
 

@@ -25,12 +25,20 @@ from typing import Any, Callable
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from flax.linen.dtypes import promote_dtype
+from jax import lax
 
 from mpit_tpu.ops.kv_quant import (
     QuantizedKV,
     dequantize_kv,
     kv_stack,
     quantize_kv,
+)
+from mpit_tpu.ops.quantized_matmul import (
+    QuantizedTensor,
+    dequantize_tensor,
+    quantized_matmul,
+    quantized_matmul_t,
 )
 
 AttentionFn = Callable[..., jax.Array]  # (q, k, v, *, causal) -> out
@@ -239,6 +247,19 @@ class GPT2Config:
     # reference :func:`paged_cached_attention`; the paged engine plugs
     # in :func:`mpit_tpu.ops.decode_attention.flash_paged_decode_attention`.
     paged_attention_fn: Any = None
+    # Matmul used when a Dense kernel seat holds a
+    # :class:`~mpit_tpu.ops.quantized_matmul.QuantizedTensor` (ISSUE
+    # 17): ``(x, qtensor) -> f32 [..., F]``. None = the blocked
+    # :func:`~mpit_tpu.ops.quantized_matmul.quantized_matmul` (Pallas
+    # fused-dequant kernel on TPU, blocked lax oracle elsewhere); the
+    # serving engine injects its interpret/reference choice here — the
+    # ``cache_attention_fn`` idiom. Irrelevant (never called) while
+    # params are plain arrays.
+    quant_matmul_fn: Any = None
+    # Contraction/vocab row-block for the quantized matmuls; 0 = the
+    # module default (256). Tests/contracts shrink it so tiny configs
+    # still exercise real multi-block tiling.
+    quant_block_rows: int = 0
 
     @property
     def ln_out_dtype(self):
@@ -268,6 +289,51 @@ class GPT2Config:
         return GPT2Config(**defaults)
 
 
+class QuantDense(nn.Module):
+    """``nn.Dense`` drop-in whose kernel seat also accepts a
+    :class:`~mpit_tpu.ops.quantized_matmul.QuantizedTensor` (ISSUE 17).
+
+    Plain-array path: byte-identical jaxpr to ``nn.Dense`` (same
+    lecun-normal/zeros init, same ``promote_dtype`` + ``dot_general``
+    structure) — the ``weights_dtype=None`` default MUST stay
+    bit-identical, compile pins included. Quantized path: the int8
+    payload + scale rows flow through ``quant_matmul_fn`` (default the
+    blocked fused-dequant matmul), f32 accumulate, bias added in f32,
+    then cast to ``dtype`` — the full dequantized kernel never
+    materializes."""
+
+    features: int
+    dtype: Any = jnp.float32
+    quant_matmul_fn: Any = None
+    block_rows: int = 0
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,),
+            jnp.float32,
+        )
+        if isinstance(kernel, QuantizedTensor):
+            if self.quant_matmul_fn is not None:
+                y = self.quant_matmul_fn(x, kernel)
+            else:
+                y = quantized_matmul(
+                    x, kernel, block_rows=self.block_rows or None
+                )
+            return (y + bias).astype(self.dtype)
+        x, kernel, bias = promote_dtype(x, kernel, bias, dtype=self.dtype)
+        y = lax.dot_general(
+            x, kernel, (((x.ndim - 1,), (0,)), ((), ()))
+        )
+        return y + jnp.reshape(bias, (1,) * (y.ndim - 1) + (-1,))
+
+
 class Block(nn.Module):
     cfg: GPT2Config
 
@@ -287,8 +353,15 @@ class Block(nn.Module):
         historical single-output signature, untouched.
         """
         cfg = self.cfg
+        dense = lambda features, name: QuantDense(
+            features,
+            dtype=cfg.dtype,
+            quant_matmul_fn=cfg.quant_matmul_fn,
+            block_rows=cfg.quant_block_rows,
+            name=name,
+        )
         h = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln1")(x)
-        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="qkv")(h)
+        qkv = dense(3 * cfg.d_model, "qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda t: t.reshape(*t.shape[:-1], cfg.num_heads, cfg.head_dim)
         if layer_cache is None:
@@ -313,12 +386,12 @@ class Block(nn.Module):
             attn = attn_fn(split(q), k_cache, v_cache, lengths)
             new_cache = (k_cache, v_cache)
         attn = attn.reshape(*attn.shape[:-2], cfg.d_model)
-        x = x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="proj")(attn)
+        x = x + dense(cfg.d_model, "proj")(attn)
 
         h = nn.LayerNorm(dtype=cfg.ln_out_dtype, name="ln2")(x)
-        h = nn.Dense(cfg.ff_dim, dtype=cfg.dtype, name="fc")(h)
+        h = dense(cfg.ff_dim, "fc")(h)
         h = nn.gelu(h)
-        x = x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="out")(h)
+        x = x + dense(cfg.d_model, "out")(h)
         return x if layer_cache is None else (x, new_cache)
 
 
@@ -419,7 +492,13 @@ class GPT2(nn.Module):
         )
         t = tokens.shape[-1]
         pe = wpe[:t] if positions is None else wpe[positions]
-        x = wte[tokens].astype(cfg.dtype) + pe.astype(cfg.dtype)
+        emb = wte[tokens]
+        if isinstance(emb, QuantizedTensor):
+            # Gather picked int8 rows AND their scales; dequantize the
+            # gathered [B, T, D] view — activation-sized, never the
+            # [vocab, D] table.
+            emb = dequantize_tensor(emb)
+        x = emb.astype(cfg.dtype) + pe.astype(cfg.dtype)
         block = Block
         if cfg.remat:
             block = nn.remat(Block)
@@ -462,12 +541,25 @@ class GPT2(nn.Module):
             return lm_head_xent(
                 x, head, targets, compute_dtype=cfg.head_dtype
             )
-        logits = jnp.einsum(
-            "btd,vd->btv",
-            x.astype(cfg.head_dtype),
-            head.astype(cfg.head_dtype),
-            preferred_element_type=jnp.float32,
-        )
+        if isinstance(head, QuantizedTensor):
+            # Blocked x @ head.T — ALWAYS, even for reference engines:
+            # the speculative draft runs this head pass inside a hot
+            # jitted step (``_spec_draft_step``), so a whole-dequant
+            # here would put a [vocab, D] f32 intermediate into a
+            # serving jaxpr. Blocking over vocab rows is bitwise
+            # identical to whole-dequant (full-D contraction per
+            # logit), so nothing is lost.
+            logits = quantized_matmul_t(
+                x.astype(cfg.head_dtype), head,
+                block_rows=cfg.quant_block_rows or None,
+            )
+        else:
+            logits = jnp.einsum(
+                "btd,vd->btv",
+                x.astype(cfg.head_dtype),
+                head.astype(cfg.head_dtype),
+                preferred_element_type=jnp.float32,
+            )
         if cache is not None or paged_cache is not None:
             return logits, (kv_stack(new_k), kv_stack(new_v))
         return logits

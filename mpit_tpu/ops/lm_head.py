@@ -36,6 +36,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from mpit_tpu.comm import collectives as C
+from mpit_tpu.ops.quantized_matmul import (
+    QuantizedTensor,
+    dequantize_tensor,
+)
 
 _NEG_BIG = -1e30  # "-inf" that survives subtraction without NaNs
 
@@ -62,7 +66,15 @@ def _match_vma(x, *refs):
 
 
 def _block_logits(h, head_block, valid, compute_dtype):
-    """[N, D] x [block, D] -> [N, block] f32 logits; padded cols -> -big."""
+    """[N, D] x [block, D] -> [N, block] f32 logits; padded cols -> -big.
+
+    A quantized head block (ISSUE 17) dequantizes HERE, per vocab tile
+    inside the scan — the only f32 view of the head that ever exists is
+    this [block, D] tile, which is exactly the in-kernel fused-dequant
+    discipline the int8 weight store demands of the decode head (the
+    single biggest weight in the model)."""
+    if isinstance(head_block, QuantizedTensor):
+        head_block = dequantize_tensor(head_block)
     logits = jnp.dot(
         h.astype(compute_dtype),
         head_block.astype(compute_dtype).T,
@@ -196,6 +208,13 @@ def lm_head_xent(
       the context-parallel tier needs the per-token granularity for its
       cross-shard target masking, ``parallel/cp.py``).
     """
+    if isinstance(head, QuantizedTensor):
+        raise ValueError(
+            "lm_head_xent is the TRAINING head — the int8 weight store "
+            "(ISSUE 17) is a serving format with no gradient contract; "
+            "train in f32 (or dequantize_tensor explicitly, accepting "
+            "the materialized [vocab, d] f32 weight)"
+        )
     vocab, d = head.shape
     block = min(block_size, _round_up(vocab, 128))
     pad = (-vocab) % block
@@ -212,6 +231,43 @@ def lm_head_xent(
 
 def _round_up(x: int, m: int) -> int:
     return x + (-x) % m
+
+
+def _head_blocks(head, block):
+    """Pad head rows to a ``block`` multiple and tile to ``[n_blocks,
+    block, d]`` — plain arrays and
+    :class:`~mpit_tpu.ops.quantized_matmul.QuantizedTensor` alike.
+    Quantized pad rows are zero int8 with scale 1.0 (exact-zero
+    dequant); either way the ``valid`` column mask in
+    :func:`_block_logits` scores pad columns ``-big`` before any merge.
+    A quantized result is itself a ``QuantizedTensor`` of tiles:
+    ``lax.scan`` slices pytree xs leaf-wise, so each tick receives one
+    ``(q [block, d], scale [block, 1])`` pair."""
+    vocab, d = head.shape
+    pad = (-vocab) % block
+    if isinstance(head, QuantizedTensor):
+        q, scale = head.q, head.scale
+        if pad:
+            q = jnp.concatenate(
+                [q, jnp.zeros((pad, d), q.dtype)], axis=0
+            )
+            scale = jnp.concatenate(
+                [scale, jnp.ones((pad, 1), scale.dtype)], axis=0
+            )
+        n = q.shape[0] // block
+        return (
+            QuantizedTensor(
+                q=q.reshape(n, block, d),
+                scale=scale.reshape(n, block, 1),
+            ),
+            n,
+        )
+    if pad:
+        head = jnp.concatenate(
+            [head, jnp.zeros((pad, d), head.dtype)], axis=0
+        )
+    n = head.shape[0] // block
+    return head.reshape(n, block, d), n
 
 
 # ---------------------------------------------------------------------------
@@ -270,13 +326,7 @@ def lm_head_sample(
     """
     vocab, d = head.shape
     block = min(block_size, _round_up(vocab, 128))
-    pad = (-vocab) % block
-    if pad:
-        head = jnp.concatenate(
-            [head, jnp.zeros((pad, d), head.dtype)], axis=0
-        )
-    n_blocks = head.shape[0] // block
-    head_blocks = head.reshape(n_blocks, block, head.shape[1])
+    head_blocks, n_blocks = _head_blocks(head, block)
     offsets = jnp.arange(n_blocks, dtype=jnp.int32) * block
     blk_ids = jnp.arange(n_blocks, dtype=jnp.int32)
     n = h.shape[0]
@@ -400,15 +450,11 @@ def lm_head_verify(
     block = min(block_size, _round_up(vocab, 128))
     pad = (-vocab) % block
     if pad:
-        head = jnp.concatenate(
-            [head, jnp.zeros((pad, d), head.dtype)], axis=0
-        )
         qprobs = jnp.concatenate(
             [qprobs, jnp.zeros((qprobs.shape[0], pad), qprobs.dtype)],
             axis=1,
         )
-    n_blocks = head.shape[0] // block
-    head_blocks = head.reshape(n_blocks, block, head.shape[1])
+    head_blocks, n_blocks = _head_blocks(head, block)
     offsets = jnp.arange(n_blocks, dtype=jnp.int32) * block
     blk_ids = jnp.arange(n_blocks, dtype=jnp.int32)
     n = h.shape[0]

@@ -43,6 +43,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from mpit_tpu.comm import collectives as C
+from mpit_tpu.ops.quantized_matmul import QuantizedTensor, quantized_matmul_lax
 
 
 def column_parallel_dense(x, kernel, bias=None):
@@ -50,8 +51,19 @@ def column_parallel_dense(x, kernel, bias=None):
 
     x: [..., D] replicated (or sequence-sharded under SP after gather);
     kernel: local [D, F/P]; bias: local [F/P] or None.
+
+    An int8-quantized kernel (``QuantizedTensor``, ISSUE 17) runs the
+    blocked fused-dequant matmul instead — per-contraction-block dequant
+    in registers, never a full f32 kernel intermediate. TP stays on the
+    XLA-blocked form (no Pallas inside shard_map — the kernel path would
+    need the vma plumbing; the blocked lax form has identical numerics).
+    Its per-row scales span the (replicated) contraction rows, so the
+    local product is exact with no extra communication.
     """
-    y = jnp.einsum("...d,df->...f", x, kernel)
+    if isinstance(kernel, QuantizedTensor):
+        y = quantized_matmul_lax(x, kernel)
+    else:
+        y = jnp.einsum("...d,df->...f", x, kernel)
     return y if bias is None else y + bias
 
 
@@ -63,8 +75,16 @@ def row_parallel_dense(x, kernel, bias=None, *, axis: str = "model", reduce: str
     sequence-sharded result via reduce-scatter on the sequence dim
     (axis -2) — the Megatron-SP exit. Bias is full [D] (replicated) and is
     added AFTER the reduction so it is counted once.
+
+    An int8-quantized kernel dispatches like
+    :func:`column_parallel_dense`; its per-row scales are sharded WITH
+    the kernel's rows (each device dequantizes exactly the F/P rows it
+    contracts), so the psum over partials is unchanged.
     """
-    partial = jnp.einsum("...f,fd->...d", x, kernel)
+    if isinstance(kernel, QuantizedTensor):
+        partial = quantized_matmul_lax(x, kernel)
+    else:
+        partial = jnp.einsum("...f,fd->...d", x, kernel)
     if reduce == "psum":
         y = lax.psum(partial, axis)
     elif reduce == "scatter":

@@ -94,6 +94,9 @@ from mpit_tpu.serve.weights import (
     expected_param_shapes,
     infer_config,
     load_gpt2_params,
+    params_wire_bytes,
+    quantize_gpt2_params,
+    weight_wire_bytes,
 )
 
 __all__ = [
@@ -123,6 +126,9 @@ __all__ = [
     "infer_config",
     "kv_wire_bytes_per_row",
     "load_gpt2_params",
+    "params_wire_bytes",
+    "quantize_gpt2_params",
+    "weight_wire_bytes",
     "parse_load_spec",
     "sample_tokens",
     "warm_engine",

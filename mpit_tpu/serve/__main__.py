@@ -36,6 +36,16 @@ shows the wire dtype (``kvd=``); ``--kv-dtype f32|bf16`` simply pin
 the dense cache dtype. Rejected with ``--decode-attention reference``
 (the oracle path dequantizes the whole cache per tick).
 
+``--weights-dtype int8`` (ISSUE 17) quantizes the OTHER ~92% of the
+decode sweep: every matmul weight (qkv/proj/fc/out kernels, wte, the
+head) stored as int8 + per-row f32 scales, dequantized one block at a
+time inside the blocked matmuls — never a full f32 weight in HBM. The
+stats line shows ``wd=``; composes freely with ``--kv-dtype int8``
+(together they quantize essentially the whole decode sweep). Rejected
+with ``--decode-attention reference`` for the same reason as the KV
+flag: the reference path materializes whole dequantized weights (the
+parity oracle, not a serving path).
+
 Roofline flight data (ISSUE 8): the engine's jitted steps register
 their ``cost_analysis()`` costs at warm, every decode tick feeds the
 length-aware achieved HBM bytes (visited-tile model) into the recorder
@@ -121,6 +131,14 @@ class ServeConfig:
     # parity oracle, not a serving path — the perf the flag buys needs
     # the fused per-tile dequant of kernel/interpret).
     kv_dtype: str = ""
+    # Weight store wire dtype (ISSUE 17). "" = dense params as loaded
+    # (default path, byte-identical); "int8" quantizes every matmul
+    # weight (per-row int8 + f32 scale through the shared rounding
+    # contract) and runs the blocked fused-dequant matmuls — the param
+    # term of the decode HBM sweep shrinks ~4x, with the same engine
+    # step surface and compile pins. Rejected with --decode-attention
+    # reference (the whole-dequant parity oracle, not a serving path).
+    weights_dtype: str = ""
     # Speculative decoding (ISSUE 13). spec_k > 0 swaps the decode tick
     # for draft-then-verify (k drafted tokens per slot, one T=k+1 target
     # verify, longest-prefix acceptance with cache rollback). The draft
@@ -175,6 +193,41 @@ def _build_engine(cfg: ServeConfig):
     if shape:
         world = mpit_tpu.init(shape, set_default=False)
         tp_axis = "model" if "model" in shape else next(iter(shape))
+
+    # Pure-flag rejections FIRST — before the checkpoint load / random
+    # init pays a compile a doomed invocation never needed.
+    if cfg.kv_dtype and cfg.kv_dtype not in ("f32", "bf16", "int8"):
+        raise SystemExit(
+            f"--kv-dtype {cfg.kv_dtype!r}: expected f32, bf16 or int8"
+        )
+    if cfg.kv_dtype == "int8" and cfg.decode_attention == "reference":
+        # Precise submit-time rejection (ISSUE 15 satellite): the dense
+        # reference engine HAS the dequant hooks (it is the parity
+        # oracle) but dequantizes the whole cache every tick — serving
+        # int8 through it pays quantization error for MORE bytes moved,
+        # the opposite of what the flag promises.
+        raise SystemExit(
+            "--kv-dtype int8 with --decode-attention reference: the "
+            "reference path materializes the full dequantized cache "
+            "per tick (it is the parity oracle, not a serving path); "
+            "use --decode-attention kernel (or interpret) for the "
+            "fused per-tile dequant"
+        )
+    if cfg.weights_dtype and cfg.weights_dtype not in ("f32", "int8"):
+        raise SystemExit(
+            f"--weights-dtype {cfg.weights_dtype!r}: expected f32 or int8"
+        )
+    if cfg.weights_dtype == "int8" and cfg.decode_attention == "reference":
+        # Same rule as --kv-dtype (ISSUE 17): the reference engine runs
+        # the whole-dequant matmul oracle — quantization error for MORE
+        # bytes moved, the opposite of the flag's promise.
+        raise SystemExit(
+            "--weights-dtype int8 with --decode-attention reference: "
+            "the reference path materializes whole dequantized weights "
+            "(it is the parity oracle, not a serving path); use "
+            "--decode-attention kernel (or interpret) for the blocked "
+            "fused-dequant matmuls"
+        )
 
     if cfg.ckpt:
         params, mcfg = load_gpt2_params(cfg.ckpt, num_heads=cfg.num_heads)
@@ -251,23 +304,6 @@ def _build_engine(cfg: ServeConfig):
         raise SystemExit(
             "--draft-ckpt/--draft-config require --spec-k >= 1"
         )
-    if cfg.kv_dtype and cfg.kv_dtype not in ("f32", "bf16", "int8"):
-        raise SystemExit(
-            f"--kv-dtype {cfg.kv_dtype!r}: expected f32, bf16 or int8"
-        )
-    if cfg.kv_dtype == "int8" and cfg.decode_attention == "reference":
-        # Precise submit-time rejection (ISSUE 15 satellite): the dense
-        # reference engine HAS the dequant hooks (it is the parity
-        # oracle) but dequantizes the whole cache every tick — serving
-        # int8 through it pays quantization error for MORE bytes moved,
-        # the opposite of what the flag promises.
-        raise SystemExit(
-            "--kv-dtype int8 with --decode-attention reference: the "
-            "reference path materializes the full dequantized cache "
-            "per tick (it is the parity oracle, not a serving path); "
-            "use --decode-attention kernel (or interpret) for the "
-            "fused per-tile dequant"
-        )
     engine = Engine(
         mcfg,
         params,
@@ -289,6 +325,7 @@ def _build_engine(cfg: ServeConfig):
         draft_params=draft_params,
         draft_cfg=draft_cfg,
         kv_dtype=cfg.kv_dtype or None,
+        weights_dtype=cfg.weights_dtype or None,
     )
     return engine, mcfg
 
@@ -351,6 +388,10 @@ def _live_line(registry, monitor, server, now: float) -> str:
         # actually moves — shown whenever it was explicitly chosen, so
         # an int8 run's hbmbw= figure is attributable from the line.
         line += f" kvd={server.engine.kv_dtype}"
+    if getattr(server.engine, "weights_dtype_explicit", False):
+        # The weight store's wire dtype (ISSUE 17): the param term of
+        # the same sweep.
+        line += f" wd={server.engine.weights_dtype}"
     if "kv_pool_occupancy" in g:
         # Cache-MEMORY efficiency next to slot occupancy (ISSUE 7):
         # pool fill, tokens actually held, pages stored once but

@@ -80,6 +80,13 @@ from mpit_tpu.models.gpt2 import (
     paged_cached_attention,
 )
 from mpit_tpu.ops.kv_quant import kv_stack
+from mpit_tpu.ops.quantized_matmul import (
+    QuantizedTensor,
+    dequantize_tensor,
+    quantized_matmul,
+    quantized_matmul_reference,
+    quantized_matmul_t,
+)
 from mpit_tpu.obs import roofline as _roofline
 from mpit_tpu.ops.decode_attention import (
     flash_decode_attention,
@@ -104,6 +111,7 @@ from mpit_tpu.serve.kvcache import (
     kv_wire_bytes_per_row,
     paged_cache_specs,
 )
+from mpit_tpu.serve.weights import params_wire_bytes, quantize_gpt2_params
 
 __all__ = ["Engine", "sample_tokens"]
 
@@ -116,6 +124,16 @@ __all__ = ["Engine", "sample_tokens"]
 # (the oracle). "f32"/"bf16" simply pin the dense cache dtype.
 _KV_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16, "int8": None}
 _DTYPE_SHORT = {"float32": "f32", "bfloat16": "bf16", "int8": "int8"}
+
+# Engine.weights_dtype values (None = dense params as loaded — the
+# default path, byte-identical to an engine that never heard of the
+# knob). "int8" (ISSUE 17) quantizes every matmul weight at
+# construction (per-row int8 + f32 scale through the SAME
+# ring-collectives rounding contract the int8 KV cache uses) and every
+# step runs the blocked fused-dequant matmul — weights dequantize one
+# VMEM tile at a time, never as a full f32 array in HBM. Same lifetime
+# compile count; the decode HBM sweep's weight term shrinks ~4x.
+_WEIGHT_DTYPES = ("f32", "int8")
 
 
 def _kv_where(mask, new, old):
@@ -194,37 +212,46 @@ def _tp_forward_body(
     positions = lengths[:, None] + jnp.arange(t)[None, :]
     if clip_positions:
         positions = jnp.minimum(positions, cfg.max_seq_len - 1)
-    x = params["wte"][tokens].astype(cfg.dtype) + params["wpe"][
-        positions
-    ].astype(cfg.dtype)
+    emb = params["wte"][tokens]
+    if isinstance(emb, QuantizedTensor):
+        # int8 weight store (ISSUE 17): the embedding GATHER picks T
+        # int8 rows + their scales; only those rows dequantize — never
+        # the whole [V, D] table.
+        emb = dequantize_tensor(emb)
+    x = emb.astype(cfg.dtype) + params["wpe"][positions].astype(cfg.dtype)
 
     dt = cfg.dtype
+    # Quantized kernels (int8 weight store) keep their int8+scale wire —
+    # the megatron dense helpers dequantize per contraction block inside
+    # the blocked matmul; plain kernels cast to the compute dtype as
+    # before.
+    wdt = lambda l: l if isinstance(l, QuantizedTensor) else l.astype(dt)
     split = lambda a: a.reshape(*a.shape[:-1], heads_local, cfg.head_dim)
     new_k, new_v = [], []
     for i in range(cfg.num_layers):
         blk = params[f"block_{i}"]
         h = M.layernorm(x, blk["ln1"]["scale"], blk["ln1"]["bias"]).astype(dt)
         qkv = M.column_parallel_dense(
-            h, blk["qkv"]["kernel"].astype(dt), blk["qkv"]["bias"].astype(dt)
+            h, wdt(blk["qkv"]["kernel"]), blk["qkv"]["bias"].astype(dt)
         )
         q, k, v = jnp.split(qkv, 3, axis=-1)
         k_i, v_i, attn = layer_kv(i, split(q), split(k), split(v))
         attn = attn.reshape(*attn.shape[:-2], -1)
         x = x + M.row_parallel_dense(
             attn,
-            blk["proj"]["kernel"].astype(dt),
+            wdt(blk["proj"]["kernel"]),
             blk["proj"]["bias"].astype(dt),
             axis=axis,
         )
         h = M.layernorm(x, blk["ln2"]["scale"], blk["ln2"]["bias"]).astype(dt)
         h = jax.nn.gelu(
             M.column_parallel_dense(
-                h, blk["fc"]["kernel"].astype(dt), blk["fc"]["bias"].astype(dt)
+                h, wdt(blk["fc"]["kernel"]), blk["fc"]["bias"].astype(dt)
             )
         )
         x = x + M.row_parallel_dense(
             h,
-            blk["out"]["kernel"].astype(dt),
+            wdt(blk["out"]["kernel"]),
             blk["out"]["bias"].astype(dt),
             axis=axis,
         )
@@ -238,12 +265,21 @@ def _tp_forward_body(
         # [B, T, vocab] logits here either.
         return x, (new_k, new_v)
     head = params.get("head", params["wte"])
-    logits = jnp.einsum(
-        "btd,vd->btv",
-        x.astype(cfg.head_dtype),
-        head.astype(cfg.head_dtype),
-        preferred_element_type=jnp.float32,
-    )
+    if isinstance(head, QuantizedTensor):
+        # Blocked x @ head.T over vocab-row tiles (ISSUE 17) — bitwise
+        # equal to the dequantized einsum (full-D contraction per
+        # logit), without a [V, D] f32 intermediate.
+        logits = quantized_matmul_t(
+            x.astype(cfg.head_dtype), head,
+            block_rows=cfg.quant_block_rows or None,
+        )
+    else:
+        logits = jnp.einsum(
+            "btd,vd->btv",
+            x.astype(cfg.head_dtype),
+            head.astype(cfg.head_dtype),
+            preferred_element_type=jnp.float32,
+        )
     return logits, (new_k, new_v)
 
 
@@ -323,7 +359,17 @@ def _trimmed_sharding(world, spec):
 
 def _tp_param_specs(cfg, params, axis: str):
     """Spec tree mirroring a dense GPT-2 param tree: ``tp_block_specs``
-    per block, everything else replicated."""
+    per block, everything else replicated.
+
+    int8 weight store (ISSUE 17): a quantized kernel is a TWO-leaf
+    pytree (int8 payload + per-row f32 scales), so its block spec
+    expands to the matching twin — the payload keeps the kernel's own
+    placement; the scales follow the kernel's ROW placement (column-
+    parallel ``P(None, axis)`` shards output columns, rows replicated →
+    scales replicated; row-parallel ``P(axis, None)`` shards the rows
+    the scales describe → scales shard with them). Replicated entries
+    (wte/head) need no special case: ``jax.tree.map`` descends into the
+    quantized pytree and replicates both leaves."""
     from jax.sharding import PartitionSpec as P
 
     from mpit_tpu.parallel.megatron import tp_block_specs
@@ -334,7 +380,19 @@ def _tp_param_specs(cfg, params, axis: str):
         if not str(k).startswith("block_")
     }
     for i in range(cfg.num_layers):
-        specs[f"block_{i}"] = tp_block_specs(axis)
+        bspecs = tp_block_specs(axis)
+        blk = params[f"block_{i}"]
+        for mod in ("qkv", "proj", "fc", "out"):
+            if isinstance(blk[mod]["kernel"], QuantizedTensor):
+                kspec = bspecs[mod]["kernel"]
+                bspecs[mod] = dict(
+                    bspecs[mod],
+                    kernel=QuantizedTensor(
+                        q=kspec,
+                        scale=P(kspec[0] if len(kspec) else None, None),
+                    ),
+                )
+        specs[f"block_{i}"] = bspecs
     return specs
 
 
@@ -370,6 +428,7 @@ class Engine:
         draft_params=None,
         draft_cfg: GPT2Config | None = None,
         kv_dtype: str | None = None,
+        weights_dtype: str | None = None,
     ):
         if decode_attention not in _DECODE_MODES:
             raise ValueError(
@@ -409,6 +468,28 @@ class Engine:
         self.kv_dtype = kv_dtype or _DTYPE_SHORT.get(
             jnp.dtype(cfg.dtype).name, jnp.dtype(cfg.dtype).name
         )
+
+        # -- weight wire dtype (ISSUE 17 tentpole) ----------------------------
+        # None = the historical default (dense params as loaded) — the
+        # path stays byte-identical, pinned by the greedy-parity suite.
+        # "int8" quantizes every matmul weight at construction (qkv/
+        # proj/fc/out kernels, wte, head — biases and LayerNorms stay
+        # f32; they are ~0.1% of the bytes and additive precision is
+        # cheap) and runs the blocked fused-dequant matmul everywhere:
+        # dense/paged/TP/chunked-prefill/speculative, at the same
+        # pinned lifetime compile count.
+        if weights_dtype is not None and weights_dtype not in _WEIGHT_DTYPES:
+            raise ValueError(
+                f"weights_dtype must be one of {list(_WEIGHT_DTYPES)} (or "
+                f"None = dense params as loaded), got {weights_dtype!r}"
+            )
+        self.weights_quantized = weights_dtype == "int8"
+        # The label (stats / span stamping / bench): what the matmul
+        # weights actually occupy HBM as. weights_dtype_explicit gates
+        # the span label — default engines' spans stay byte-identical
+        # (the kv_dtype idiom).
+        self.weights_dtype_explicit = weights_dtype is not None
+        self.weights_dtype = weights_dtype or "f32"
 
         # -- paged KV pool (ISSUE 7 tentpole) --------------------------------
         # kv_pages selects the paged engine: HBM holds a fixed pool of
@@ -562,6 +643,34 @@ class Engine:
                 },
             )
             self.cfg = cfg  # what the forward really runs, kernel included
+        if self.weights_quantized:
+            # The quantized matmul the model's dense layers run (the
+            # cache_attention_fn injection idiom). Reference engines get
+            # the whole-dequant oracle — deliberately materializing the
+            # f32 weight, the anti-vacuity baseline the jaxpr contract
+            # compares against; kernel/interpret engines run the blocked
+            # two-channel-DMA fused-dequant matmul (its lax fallback
+            # off-TPU — same blocked numerics, parity-pinned).
+            if decode_attention == "reference":
+                qmm = functools.partial(
+                    quantized_matmul_reference,
+                    block_rows=cfg.quant_block_rows or None,
+                )
+            else:
+                qmm = functools.partial(
+                    quantized_matmul,
+                    block_rows=cfg.quant_block_rows or None,
+                    interpret=(
+                        True if decode_attention == "interpret" else None
+                    ),
+                )
+            cfg = dataclasses.replace(cfg, quant_matmul_fn=qmm)
+            self.cfg = cfg
+            if tp_axis is None:
+                params = quantize_gpt2_params(params)
+            # TP quantizes AFTER repack_qkv below: repack permutes
+            # kernel COLUMNS (per-row scales are column-permutation
+            # invariant, but the reshape needs plain arrays).
 
         sharding = None
         if tp_axis is not None:
@@ -578,6 +687,8 @@ class Engine:
                 k: repack_qkv(v, p) if str(k).startswith("block_") else v
                 for k, v in params.items()
             }
+            if self.weights_quantized:
+                params = quantize_gpt2_params(params)
             self._specs = _tp_param_specs(cfg, params, tp_axis)
             params = jax.device_put(
                 params,
@@ -649,6 +760,25 @@ class Engine:
         # proposal distribution q is part of the acceptance contract.
         # The draft stays REPLICATED under TP (its per-tick cost is the
         # speculation overhead; sharding a 2-layer draft buys nothing).
+        if self.spec_k and self.weights_quantized:
+            # The draft rides the SAME weight wire (ISSUE 17): the
+            # acceptance-rate contract compares int8-draft proposals to
+            # int8-target verification, so both sides quantize. Always
+            # the BLOCKED matmul, even on a reference engine — the
+            # draft's head runs inside the hot _spec_draft_step, and a
+            # whole-dequant there would re-materialize [V, D] f32 every
+            # tick (exactly what this PR removes).
+            draft_cfg = dataclasses.replace(
+                draft_cfg,
+                quant_matmul_fn=functools.partial(
+                    quantized_matmul,
+                    block_rows=draft_cfg.quant_block_rows or None,
+                    interpret=(
+                        True if decode_attention == "interpret" else None
+                    ),
+                ),
+            )
+            draft_params = quantize_gpt2_params(draft_params)
         self.draft_cfg = draft_cfg
         self._spec_state = None  # device-side (drafted, q_x, q_probs)
         if self.spec_k:
@@ -756,13 +886,11 @@ class Engine:
         )
         # Per-execution modeled costs (set by register_roofline).
         self.roofline_costs: dict | None = None
-        self._param_bytes = float(
-            sum(
-                l.size * l.dtype.itemsize
-                for l in jax.tree.leaves(params)
-                if hasattr(l, "dtype")
-            )
-        )
+        # WIRE bytes, not logical bytes: an int8 weight store's param
+        # read per decode tick is the int8 payload + the f32 scale
+        # column (ISSUE 17 — decode_achieved_hbm_bytes must count what
+        # the DMA moves, the kv_dtype honesty rule applied to weights).
+        self._param_bytes = params_wire_bytes(params)
         # One cached K (or V) row of one layer, at the ACTUAL wire
         # dtype — the unit of the length-aware decode-bytes model.
         # int8 rows carry their scale blocks (ISSUE 15 roofline

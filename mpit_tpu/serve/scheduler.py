@@ -330,6 +330,14 @@ class Server:
             if getattr(engine, "kv_dtype_explicit", False)
             else {}
         )
+        # weights_dtype rides the same spans under the same rule
+        # (ISSUE 17): the int8 weight store halves the decode sweep, so
+        # a why-slow trace must say which wire the tick paid for — but
+        # only explicitly-chosen engines get the label.
+        if getattr(engine, "weights_dtype_explicit", False):
+            self._kv_attrs = dict(
+                self._kv_attrs, weights_dtype=engine.weights_dtype
+            )
         self._paged = bool(getattr(engine, "paged", False))
         # Speculative decoding (ISSUE 13): spec_k > 0 swaps the decode
         # tick for draft-then-verify; the accumulators feed stats()'s
@@ -1425,6 +1433,11 @@ class Server:
         kv_dtype = getattr(self.engine, "kv_dtype", None)
         if kv_dtype is not None:
             out["kv_dtype"] = kv_dtype
+        # The weight store's wire dtype (ISSUE 17), same rule: "int8"
+        # when the matmul weights live as int8+scales, "f32" otherwise.
+        weights_dtype = getattr(self.engine, "weights_dtype", None)
+        if weights_dtype is not None:
+            out["weights_dtype"] = weights_dtype
         watch = getattr(self.engine, "compile_watch", None)
         if watch is not None:
             # The runtime-guarded compile claim (ISSUE 8): 2 for the

@@ -14,8 +14,18 @@ side:
   :class:`mpit_tpu.serve.Engine`;
 - :func:`infer_config` — reconstruct the :class:`GPT2Config` geometry
   from the param tree itself (vocab/max_seq_len/layers/d_model/d_ff and
-  head-tying are all shape-derivable; ``num_heads`` is not — it must be
-  supplied, defaulting to GPT-2's d_model/64 convention).
+  head-tying are all shape-derivable; ``num_heads`` is not — it comes
+  from the checkpoint's own metadata when the export recorded it
+  (``save_dense(..., num_heads=...)``, ISSUE 17), else it must be
+  supplied, defaulting to GPT-2's d_model/64 convention);
+- :func:`quantize_gpt2_params` — the int8 weight store (ISSUE 17):
+  every matmul weight quantized per row through the shared
+  ``quantize_blocks`` contract into
+  :class:`~mpit_tpu.ops.quantized_matmul.QuantizedTensor` leaves,
+  biases/LayerNorms/``wpe`` left f32;
+- :func:`params_wire_bytes` — what the tree actually costs on the HBM
+  wire, through the shared :func:`weight_wire_bytes` sizing rule (the
+  roofline's param term and the bench capacity math read this).
 """
 
 from __future__ import annotations
@@ -27,13 +37,25 @@ import jax
 import jax.numpy as jnp
 
 from mpit_tpu.models.gpt2 import GPT2Config
+from mpit_tpu.ops.quantized_matmul import (
+    QuantizedTensor,
+    quantize_tensor,
+    weight_wire_bytes,
+)
 
 __all__ = [
     "draft_from_target",
     "expected_param_shapes",
     "infer_config",
     "load_gpt2_params",
+    "params_wire_bytes",
+    "quantize_gpt2_params",
+    "weight_wire_bytes",
 ]
+
+# The matmul kernels inside each transformer block that the int8 store
+# quantizes (the Megatron-named hooks); biases and LayerNorms stay f32.
+_QUANT_BLOCK_MODULES = ("qkv", "proj", "fc", "out")
 
 
 def expected_param_shapes(cfg: GPT2Config) -> dict[str, tuple[int, ...]]:
@@ -70,28 +92,49 @@ def _flatten(tree: Mapping) -> dict[str, Any]:
     return flat
 
 
-def infer_config(params: Mapping, *, num_heads: int = 0, **overrides) -> GPT2Config:
+def infer_config(
+    params: Mapping,
+    *,
+    num_heads: int = 0,
+    meta: Mapping | None = None,
+    **overrides,
+) -> GPT2Config:
     """Reconstruct the serving :class:`GPT2Config` from a dense param
-    tree. Every geometry field except the head count is shape-derivable;
-    ``num_heads = 0`` falls back to the GPT-2 convention ``d_model/64``
-    (correct for the small/medium/large/xl family, WRONG for e.g.
-    ``GPT2Config.tiny`` — d_model 64, 4 heads — and undetectable from
-    shapes, so always pass ``--num-heads`` when serving a non-standard
-    checkpoint; a mismatch serves garbage silently). Extra kwargs
-    override config fields (e.g. ``dtype=jnp.float32`` for parity
-    testing)."""
+    tree. Every geometry field except the head count is shape-derivable.
+    Head-count resolution order: an explicit ``num_heads`` argument,
+    then the checkpoint's own ``meta`` (``save_dense`` records
+    ``num_heads``/``tie_head`` since ISSUE 17 — the fix for the
+    historical silent-garbage trap), then the GPT-2 convention
+    ``d_model/64`` (correct for the small/medium/large/xl family, WRONG
+    for e.g. ``GPT2Config.tiny`` — d_model 64, 4 heads — and
+    undetectable from shapes, so pass ``--num-heads`` when serving a
+    non-standard checkpoint that predates the metadata; a mismatch
+    serves garbage silently). A recorded ``tie_head`` that contradicts
+    the tree's own shape evidence raises — that is a corrupt or
+    mis-assembled checkpoint, not a preference. Extra kwargs override
+    config fields (e.g. ``dtype=jnp.float32`` for parity testing)."""
     vocab, d_model = params["wte"].shape
     max_seq_len = params["wpe"].shape[0]
     num_layers = sum(1 for k in params if str(k).startswith("block_"))
     d_ff = params["block_0"]["fc"]["kernel"].shape[1]
+    meta = dict(meta or {})
+    tie_head = "head" not in params
+    if "tie_head" in meta and bool(meta["tie_head"]) != tie_head:
+        raise ValueError(
+            f"checkpoint metadata says tie_head={bool(meta['tie_head'])} "
+            f"but the param tree {'has no' if tie_head else 'has a'} "
+            "separate head leaf — corrupt or mis-assembled checkpoint"
+        )
     kw = dict(
         vocab_size=int(vocab),
         max_seq_len=int(max_seq_len),
         num_layers=int(num_layers),
-        num_heads=int(num_heads) or max(int(d_model) // 64, 1),
+        num_heads=int(num_heads)
+        or int(meta.get("num_heads", 0))
+        or max(int(d_model) // 64, 1),
         d_model=int(d_model),
         d_ff=int(d_ff),
-        tie_head="head" not in params,
+        tie_head=tie_head,
     )
     kw.update(overrides)
     return GPT2Config(**kw)
@@ -114,6 +157,60 @@ def validate_params(cfg: GPT2Config, params: Mapping) -> None:
             "dense checkpoint does not match the serve param contract: "
             f"missing={missing} extra={extra} shape-mismatch={wrong}"
         )
+
+
+def quantize_gpt2_params(params: Mapping) -> dict:
+    """The int8 weight store (ISSUE 17): every matmul weight — the
+    ``qkv``/``proj``/``fc``/``out`` kernels plus ``wte`` and the untied
+    ``head`` — quantized per row through the shared
+    :func:`~mpit_tpu.ops.ring_collectives.quantize_blocks` contract
+    into :class:`~mpit_tpu.ops.quantized_matmul.QuantizedTensor`
+    leaves (int8 payload + f32 scale rows riding together, the
+    ``QuantizedKV`` mold). Biases, LayerNorms and ``wpe`` stay f32 —
+    they are a rounding error of the wire and the model sums them in
+    f32 anyway. Idempotent on already-quantized leaves; shares leaves
+    with the input tree where nothing changes (so a layer-truncated
+    draft built from the same target still aliases the quantized
+    embedding/head)."""
+
+    def q(leaf):
+        return leaf if isinstance(leaf, QuantizedTensor) else quantize_tensor(
+            jnp.asarray(leaf)
+        )
+
+    out: dict[str, Any] = {}
+    for key, val in params.items():
+        key = str(key)
+        if key.startswith("block_"):
+            blk = dict(val)
+            for mod in _QUANT_BLOCK_MODULES:
+                blk[mod] = dict(blk[mod], kernel=q(blk[mod]["kernel"]))
+            out[key] = blk
+        elif key in ("wte", "head"):
+            out[key] = q(val)
+        else:
+            out[key] = val
+    return out
+
+
+def params_wire_bytes(params) -> float:
+    """HBM bytes the param tree actually occupies on the wire, through
+    the shared :func:`weight_wire_bytes` sizing rule — quantized leaves
+    cost int8 + one f32 scale per row, dense leaves their dtype. This
+    is THE param term every byte claim shares: the engine's
+    ``decode_achieved_hbm_bytes``, the roofline model and the bench
+    capacity math all read it (the ``kv_wire_bytes_per_row``
+    discipline, applied to weights)."""
+    total = 0.0
+    leaves = jax.tree.leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
+    for leaf in leaves:
+        if isinstance(leaf, QuantizedTensor):
+            total += weight_wire_bytes(leaf.shape, "int8")
+        elif hasattr(leaf, "dtype"):
+            total += weight_wire_bytes(leaf.shape, leaf.dtype)
+    return total
 
 
 def draft_from_target(params: Mapping, cfg: GPT2Config, num_layers: int):
@@ -158,6 +255,8 @@ def load_gpt2_params(path: str, *, num_heads: int = 0, **overrides):
 
     dense = load_dense(path)
     params = jax.tree.map(jnp.asarray, dense.params)
-    cfg = infer_config(params, num_heads=num_heads, **overrides)
+    cfg = infer_config(
+        params, num_heads=num_heads, meta=dense.meta, **overrides
+    )
     validate_params(cfg, params)
     return params, cfg

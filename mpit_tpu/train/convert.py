@@ -65,6 +65,12 @@ class DenseState:
     params: Any  # dense GPT-2 param tree
     moments: list  # dense trees, one per vector leaf of the goo state
     scalars: list  # non-vector state leaves (e.g. adam count), in order
+    # Shape-underivable model geometry (ISSUE 17): ``num_heads`` (and
+    # ``tie_head``) recorded at export time so the serve loader stops
+    # guessing the d_model/64 convention — the historical silent-garbage
+    # trap for non-standard checkpoints. Plain ints/bools only; empty on
+    # pre-17 checkpoints (the loader falls back to the convention).
+    meta: dict = dataclasses.field(default_factory=dict)
 
 
 def _is_vec(leaf) -> bool:
@@ -653,8 +659,13 @@ def dense_from_3d(
 # shards re-cut (the preempt→rescale story, RECOVERY.md §4).
 
 
-def save_dense(path: str, dense: DenseState) -> str:
-    """Write a :class:`DenseState` as one ``.npz`` (portable numpy)."""
+def save_dense(path: str, dense: DenseState, **meta) -> str:
+    """Write a :class:`DenseState` as one ``.npz`` (portable numpy).
+
+    Extra ``meta`` kwargs (e.g. ``num_heads=4, tie_head=True``) merge
+    over ``dense.meta`` and land as ``meta/<key>`` scalar entries —
+    the shape-underivable geometry the serve loader prefers over its
+    d_model/64 fallback (ISSUE 17)."""
     import os
 
     arrays: dict[str, np.ndarray] = {"__step__": np.asarray(dense.step)}
@@ -665,6 +676,8 @@ def save_dense(path: str, dense: DenseState) -> str:
             arrays[f"m{m}/" + jax.tree_util.keystr(kp)] = np.asarray(leaf)
     for i, s in enumerate(dense.scalars):
         arrays[f"s/{i}"] = np.asarray(s)
+    for key, val in {**dense.meta, **meta}.items():
+        arrays[f"meta/{key}"] = np.asarray(val)
     tmp = path + ".tmp.npz"
     np.savez(tmp, **arrays)
     os.replace(tmp, path)  # atomic: no torn file on preemption
@@ -686,7 +699,7 @@ def load_dense(path: str) -> DenseState:
 
     with np.load(path) as z:
         step = int(z["__step__"])
-        params_flat, moments_flat, scalars = {}, {}, {}
+        params_flat, moments_flat, scalars, meta = {}, {}, {}, {}
         for key in z.files:
             if key == "__step__":
                 continue
@@ -697,6 +710,8 @@ def load_dense(path: str) -> DenseState:
                 params_flat[clean] = z[key]
             elif head == "s":
                 scalars[int(rest)] = z[key]
+            elif head == "meta":
+                meta[rest] = z[key].item()
             else:
                 moments_flat.setdefault(int(head[1:]), {})[clean] = z[key]
     return DenseState(
@@ -704,4 +719,5 @@ def load_dense(path: str) -> DenseState:
         params=nest(params_flat),
         moments=[nest(moments_flat[m]) for m in sorted(moments_flat)],
         scalars=[scalars[i] for i in sorted(scalars)],
+        meta=meta,
     )

@@ -1,0 +1,27 @@
+"""Corpus: the quantized-weights jaxpr contract catches a whole-weight
+dequant (ISSUE 17).
+
+``project`` spells the tempting-but-wrong int8 weight read: dequantize
+the ENTIRE kernel to f32 up front, then matmul — exactly the
+full-weight f32 intermediate the blocked fused-dequant matmul exists to
+avoid (it makes the decode tick's param sweep move the f32 bytes AND
+the int8 bytes, worse than never quantizing). Unlike the static-rule
+corpus twins this file IS imported (by
+``tests/test_analysis.py::TestQuantizedWeightsCorpus``) and traced;
+``assert_no_intermediate(..., dtype=float32)`` must flag the
+kernel-shaped f32 output. No static rule fires here — the whole-corpus
+lint pin stays at its eight seeded violations.
+"""
+
+import jax.numpy as jnp
+
+from mpit_tpu.ops.ring_collectives import dequantize_blocks
+
+ROWS, COLS = 32, 96
+
+
+def project(x, w_q, w_scale, bias):
+    """x [B, D] against an int8 kernel [D, F] + per-row scales [D, 1]:
+    dequantizes the WHOLE kernel first — the violation."""
+    w_f32 = dequantize_blocks(w_q, w_scale)  # [D, F] f32 — full width
+    return jnp.einsum("bd,df->bf", x, w_f32) + bias

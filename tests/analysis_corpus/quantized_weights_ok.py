@@ -1,0 +1,33 @@
+"""Corpus: the per-block dequant discipline passes the
+quantized-weights contract (ISSUE 17) — the false-positive guard for
+``quantized_weights_bad.py``.
+
+``project`` contracts the same int8 kernel one ROW-block at a time:
+each iteration dequantizes one [block, F] tile (the block's int8 rows
+times their scales) and accumulates its partial product, so the largest
+f32 kernel-shaped intermediate is ``[block, F]``, never ``[D, F]``.
+This is the shape of the real blocked matmul
+(:func:`mpit_tpu.ops.quantized_matmul.quantized_matmul_lax`); the
+kernel-shaped f32 aval the contract hunts must NOT appear. No static
+rule fires here.
+"""
+
+import jax.numpy as jnp
+
+from mpit_tpu.ops.ring_collectives import dequantize_blocks
+
+ROWS, COLS = 32, 96
+BLOCK = 8
+
+
+def project(x, w_q, w_scale, bias):
+    """x [B, D] against an int8 kernel [D, F] + per-row scales [D, 1],
+    dequantized per row-block — the clean idiom."""
+    d = w_q.shape[0]
+    acc = jnp.zeros((x.shape[0], w_q.shape[1]), jnp.float32)
+    for i in range(0, d, BLOCK):
+        w_tile = dequantize_blocks(
+            w_q[i : i + BLOCK], w_scale[i : i + BLOCK]
+        )  # [BLOCK, F] f32 — tile-sized, the allowed grain
+        acc = acc + jnp.einsum("bd,df->bf", x[:, i : i + BLOCK], w_tile)
+    return acc + bias

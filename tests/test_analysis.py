@@ -417,6 +417,63 @@ class TestQuantizedDecodeCorpus:
             assert code == 0, [v.format() for v in violations]
 
 
+class TestQuantizedWeightsCorpus:
+    """ISSUE 17 corpus pair: the traced quantized-weights discipline —
+    whole-kernel dequant is caught, per-row-block dequant passes. (The
+    real engine's contract lives in the sweep; this pins the DETECTOR
+    on minimal seeded code, like the ISSUE 15 pair.)"""
+
+    def _trace(self, name):
+        import importlib.util
+
+        import jax
+        import jax.numpy as jnp
+
+        spec = importlib.util.spec_from_file_location(
+            name, corpus(f"{name}.py")
+        )
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        d, f = m.ROWS, m.COLS
+        jx = jax.make_jaxpr(m.project)(
+            jnp.zeros((2, d), jnp.float32),
+            jnp.zeros((d, f), jnp.int8),
+            jnp.ones((d, 1), jnp.float32),
+            jnp.zeros((f,), jnp.float32),
+        )
+        return jx, (d, f)
+
+    def test_bad_whole_kernel_dequant_is_caught(self):
+        import jax.numpy as jnp
+
+        jx, kernel = self._trace("quantized_weights_bad")
+        with pytest.raises(
+            jaxpr_check.JaxprContractError, match="materializes"
+        ):
+            jaxpr_check.assert_no_intermediate(
+                jx, kernel, what="corpus bad",
+                dtype=jnp.dtype(jnp.float32),
+            )
+
+    def test_ok_per_block_dequant_passes(self):
+        import jax.numpy as jnp
+
+        jx, kernel = self._trace("quantized_weights_ok")
+        jaxpr_check.assert_no_intermediate(
+            jx, kernel, what="corpus ok", dtype=jnp.dtype(jnp.float32)
+        )
+
+    def test_corpus_pair_seeds_no_static_violations(self):
+        for name in ("quantized_weights_bad", "quantized_weights_ok"):
+            code, violations = run_static([corpus(f"{name}.py")])
+            assert code == 0, [v.format() for v in violations]
+
+    def test_registered_in_sweep(self):
+        """The real engine's contract is registered (a rename must not
+        silently drop the pin)."""
+        assert "quantized-weights" in jaxpr_check.CONTRACTS
+
+
 class TestLockdep:
     def _mk_locks(self, n):
         # Created through the patched factory with package="tests", so

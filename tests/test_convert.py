@@ -439,6 +439,50 @@ class TestServeLeafContract:
         with pytest.raises(ValueError, match="contract"):
             load_gpt2_params(path, num_heads=CFG.num_heads)
 
+    def test_num_heads_metadata_roundtrip(self, tmp_path):
+        """ISSUE 17 satellite: ``save_dense(..., num_heads=..)`` records
+        the shape-underivable head count; the serve loader prefers it
+        over the d_model/64 convention — which is WRONG for this CFG
+        (d_model 64 → 1 head, trained with 4), the historical
+        silent-garbage trap."""
+        from mpit_tpu.serve.weights import load_gpt2_params
+        from mpit_tpu.train.convert import DenseState, load_dense, save_dense
+
+        params = jax.tree.map(np.asarray, _init_params())
+        path = str(tmp_path / "meta.npz")
+        save_dense(
+            path,
+            DenseState(step=0, params=params, moments=[], scalars=[]),
+            num_heads=CFG.num_heads,
+            tie_head=CFG.tie_head,
+        )
+        meta = load_dense(path).meta
+        assert meta == {"num_heads": CFG.num_heads,
+                        "tie_head": CFG.tie_head}
+        # NO --num-heads: resolution comes from the metadata, not the
+        # convention (which would serve 1-head garbage here).
+        _, cfg = load_gpt2_params(path)
+        assert cfg.num_heads == CFG.num_heads == 4
+        assert CFG.d_model // 64 != CFG.num_heads  # the gate is real
+
+    def test_tie_head_metadata_contradiction_raises(self, tmp_path):
+        """A recorded ``tie_head`` that contradicts the tree's own head
+        leaf is a corrupt checkpoint, not a preference."""
+        from mpit_tpu.serve.weights import load_gpt2_params
+        from mpit_tpu.train.convert import DenseState, save_dense
+
+        params = jax.tree.map(np.asarray, _init_params())
+        assert "head" in params  # CFG is untied
+        path = str(tmp_path / "lied.npz")
+        save_dense(
+            path,
+            DenseState(step=0, params=params, moments=[], scalars=[]),
+            num_heads=CFG.num_heads,
+            tie_head=True,  # contradicts the separate head leaf
+        )
+        with pytest.raises(ValueError, match="tie_head"):
+            load_gpt2_params(path)
+
     @staticmethod
     def _trained_state(params0):
         """A couple of real DP steps so the export is a TRAINED state,

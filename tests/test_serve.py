@@ -1038,6 +1038,10 @@ class TestPagedServing:
 
 
 class TestServeCLI:
+    # Wall-guard demotion (ISSUE 17): heavy parity/e2e soak -> the
+    # slow tier; this container replays tier-1 ~13% slower than the
+    # PR-16 recording and the guard fired (the PR-14 remedy).
+    @pytest.mark.slow
     def test_cli_smoke_random_init(self):
         from mpit_tpu.serve.__main__ import main
 
