@@ -37,6 +37,14 @@ Thirteen PRs of informal discipline, encoded (ISSUE 14 tentpole):
   decision IS ledgered: a new decision point that silently skips the
   ledger makes exactly the requests it touches invisible to why-slow
   forensics (ISSUE 16).
+- ``memledger-seam`` — every allocation/free seam named in
+  ``DEFAULT_CONFIG.memledger_seams`` (the page allocator's grant/free
+  transitions, the weight/draft store registrations) must emit a
+  memory-ledger event (a call through an attr chain containing
+  "memledger") or carry an ``# analysis: allow(memledger-seam)``
+  suppression stating where the bytes ARE accounted: one silent seam
+  and the conservation invariant (grants − frees == held) breaks for
+  every capacity verdict downstream (ISSUE 18).
 
 Device-value tracking for ``host-sync-in-hot-seam`` is a local taint
 pass: seeds are calls into ``jnp.*`` / ``jax.*``, jitted handles
@@ -90,6 +98,11 @@ R_LEDGER_SEAM = register_rule(
     "scheduler/policy decision seam emits no request-ledger event — "
     "new decision points must not go dark in why-slow forensics",
 )
+R_MEMLEDGER_SEAM = register_rule(
+    "memledger-seam",
+    "allocation/free seam emits no memory-ledger event — one silent "
+    "seam breaks byte conservation for every capacity verdict",
+)
 
 
 @dataclasses.dataclass
@@ -112,6 +125,10 @@ class LintConfig:
     # each must emit a ledger event (a call through an attr chain
     # containing "ledger") or carry # analysis: allow(ledger-seam)
     ledger_seams: dict = dataclasses.field(default_factory=dict)
+    # path suffix -> qualnames of HBM allocation/free seams: each must
+    # emit a memory-ledger event (attr chain containing "memledger")
+    # or carry # analysis: allow(memledger-seam)
+    memledger_seams: dict = dataclasses.field(default_factory=dict)
 
 
 DEFAULT_CONFIG = LintConfig(
@@ -153,6 +170,19 @@ DEFAULT_CONFIG = LintConfig(
             "Server._maybe_retire",
         },
         "mpit_tpu/serve/policy.py": {"SchedulingPolicy.should_shed"},
+    },
+    # HBM allocation/free seams (ISSUE 18): every physical byte
+    # transition must hit the memory ledger, or conservation breaks.
+    memledger_seams={
+        "mpit_tpu/serve/kvcache.py": {
+            "PageAllocator.admit",
+            "PageAllocator.free_slot",
+            "PageAllocator.cow_before_write",
+            "PageAllocator._trim_reserve",
+            "PageAllocator.reset",
+        },
+        "mpit_tpu/serve/weights.py": {"register_param_store"},
+        "mpit_tpu/serve/spec.py": {"register_draft_store"},
     },
 )
 
@@ -584,6 +614,29 @@ def _lint_ledger_seam(sf: SourceFile, qualname: str, fn, out) -> None:
         out.append(v)
 
 
+def _lint_memledger_seam(sf: SourceFile, qualname: str, fn, out) -> None:
+    """A configured allocation/free seam must emit at least one
+    memory-ledger event — any call whose attribute chain passes through
+    a name containing "memledger" (``self.memledger.grant(...)``,
+    ``memledger.register(...)``) counts; guard sites
+    (``if self.memledger is not None:``) keep the seam wired even when
+    the ledger is absent at runtime."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if any("memledger" in part for part in chain):
+                return
+    v = sf.violation(
+        R_MEMLEDGER_SEAM, fn,
+        f"allocation/free seam {qualname} emits no memory-ledger event "
+        "— bytes moving here are unattributed and the conservation "
+        "invariant (grants - frees == held) breaks; emit one or "
+        "suppress with # analysis: allow(memledger-seam)",
+    )
+    if v:
+        out.append(v)
+
+
 def lint_file(
     sf: SourceFile, cfg: LintConfig = DEFAULT_CONFIG,
     rules: set | None = None,
@@ -619,6 +672,16 @@ def lint_file(
             marked = sf.func_role("ledger-seam", fn.lineno)
             if qualname in ledger_quals or marked:
                 _lint_ledger_seam(sf, qualname, fn, out)
+
+    if on(R_MEMLEDGER_SEAM):
+        memledger_quals = set()
+        for suffix, quals in cfg.memledger_seams.items():
+            if _module_matches(sf.path, [suffix]):
+                memledger_quals |= set(quals)
+        for qualname, fn in qualname_visit(sf.tree):
+            marked = sf.func_role("memledger-seam", fn.lineno)
+            if qualname in memledger_quals or marked:
+                _lint_memledger_seam(sf, qualname, fn, out)
 
     if on(R_DETERMINISM) and (
         _module_matches(sf.path, cfg.determinism_modules)

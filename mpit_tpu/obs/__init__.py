@@ -83,6 +83,20 @@ the worst lifeline, and :class:`TraceContext` serializes over compat
 Send/Recv (dedicated tags, byte-identical) for the future
 disaggregated-fleet router.
 
+ISSUE 18 adds the MEMORY layer (:mod:`~mpit_tpu.obs.memledger`):
+a byte-exact device-memory ledger every HBM-holding serve subsystem
+registers with — weight store (int8 q + scale blocks at wire width),
+KV page pool (per-page grant/free/COW-reserve lifecycle), draft
+engine, step buffers — so ``ledger.held()`` decomposes total HBM into
+attributed components and ``grants − frees == held`` holds exactly.
+Headroom/watermark/fragmentation gauges feed the stream registry,
+pool-exhaustion edges dump a ranked top-holders table, eviction
+candidates (parked victims / idle tails / sole-reader prefixes) are
+ranked by last-touch tick for the tiering hand-off, and ``python -m
+mpit_tpu.obs capacity`` prints the offline verdict — on-TPU reconciled
+against ``device.memory_stats()``, off-TPU platform-labeled modeled
+bytes (never fabricated device numbers).
+
 Instrumented call sites: ``train.loop.hardened_loop`` (prefetch-wait /
 step / host-fence / eval / checkpoint / divergence-restore phases),
 ``comm.collectives`` (per-op modeled wire bytes — recorded at *trace*
@@ -96,7 +110,15 @@ fast path costs a module-global check and the package can be imported
 from anywhere in the stack without cycles.
 """
 
-from mpit_tpu.obs import aggregate, baseline, roofline, slo, stream, trace
+from mpit_tpu.obs import (
+    aggregate,
+    baseline,
+    memledger,
+    roofline,
+    slo,
+    stream,
+    trace,
+)
 from mpit_tpu.obs.core import (
     Recorder,
     counter,
@@ -118,6 +140,7 @@ from mpit_tpu.obs.export import (
     snapshot_trace_events,
     traffic_matrix,
 )
+from mpit_tpu.obs.memledger import MemLedger
 from mpit_tpu.obs.sentinel import Sentinel
 from mpit_tpu.obs.slo import SLO, SLOMonitor
 from mpit_tpu.obs.stream import HistogramSketch, StreamRegistry
@@ -126,6 +149,7 @@ from mpit_tpu.obs.trace import Ledger, TraceContext
 __all__ = [
     "HistogramSketch",
     "Ledger",
+    "MemLedger",
     "Recorder",
     "SLO",
     "SLOMonitor",
@@ -145,6 +169,7 @@ __all__ = [
     "get_recorder",
     "instant",
     "local_recorder",
+    "memledger",
     "roofline",
     "slo",
     "snapshot_trace_events",

@@ -22,11 +22,19 @@ Usage::
     python -m mpit_tpu.obs diff BENCH_DETAIL.json BENCH_DETAIL.new.json \
         --workload alexnet                       # bench snapshots
     python -m mpit_tpu.obs why-slow BENCH_DETAIL.json  # worst exemplar
+    python -m mpit_tpu.obs capacity BENCH_DETAIL.json \
+        --workload gpt2_serve                    # HBM capacity verdict
 
 **Why-slow mode** (ISSUE 16: request-ledger forensics) reads a ledger
 snapshot, a ``Server.stats()`` dump, or a BENCH_DETAIL.json with
 ``trace_forensics`` blocks and prints the worst retained exemplar's
 lifeline + latency-attribution table.
+
+**Capacity mode** (ISSUE 18: the HBM memory ledger) reads a
+:meth:`MemLedger.snapshot`, a ``Server.stats()`` dump carrying a
+``memory`` block, or a serve BENCH_DETAIL.json and prints the capacity
+verdict: held bytes by subsystem, KV headroom, eviction candidates,
+device reconciliation, and the conservation verdict.
 
 Exit status: 0 on success; trace mode exits 2 when the file holds no
 span events (a truncated or foreign trace — don't let an empty gap
@@ -35,7 +43,9 @@ tolerance (phase-time growth OR a utilization drop, ISSUE 8) and 2 on
 unusable input — malformed files, truncated event buffers, or a
 baseline phase missing from the current snapshot; why-slow mode exits
 2 on unusable input — no ledger block, zero exemplars, or a ledger
-that dropped events (forensics over holes would misattribute).
+that dropped events (forensics over holes would misattribute);
+capacity mode exits 2 when the input carries no memory-ledger data (a
+verdict over a snapshot without ledger bytes would be fabricated).
 """
 
 from __future__ import annotations
@@ -44,7 +54,7 @@ import argparse
 import json
 import sys
 
-from mpit_tpu.obs import baseline, trace
+from mpit_tpu.obs import baseline, memledger, trace
 from mpit_tpu.obs.core import gap_attribution, phase_stats
 
 
@@ -190,6 +200,35 @@ def _main_why_slow(argv) -> int:
     return 0
 
 
+def _main_capacity(argv) -> int:
+    """The ``capacity`` subcommand: the HBM memory-ledger verdict."""
+    ap = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.obs capacity",
+        description="Print the byte-exact HBM capacity verdict (held "
+        "decomposition, KV headroom, eviction candidates, conservation) "
+        "from a MemLedger snapshot, a Server.stats() dump with a "
+        "'memory' block, or a BENCH_DETAIL.json from a serve bench.",
+    )
+    ap.add_argument("input", help="memledger snapshot / stats dump / "
+                    "BENCH_DETAIL.json")
+    ap.add_argument(
+        "--workload", default=None,
+        help="which BENCH_DETAIL workload's memory block to read",
+    )
+    args = ap.parse_args(argv)
+    try:
+        with open(args.input) as f:
+            doc = json.load(f)
+        report = memledger.capacity_report(doc, workload=args.workload)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        # Unusable input (the obs-diff rule): a capacity verdict over
+        # a snapshot with no ledger data would be fabricated — refuse.
+        print(json.dumps({"error": str(e)}))
+        return 2
+    print(memledger.format_capacity(report))
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -197,6 +236,8 @@ def main(argv=None) -> int:
         return _main_diff(argv[1:])
     if argv and argv[0] == "why-slow":
         return _main_why_slow(argv[1:])
+    if argv and argv[0] == "capacity":
+        return _main_capacity(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m mpit_tpu.obs",
         description="Offline trace summary + app-path gap attribution.",

@@ -36,10 +36,15 @@ __all__ = ["FORMAT", "diff", "load", "save", "snapshot"]
 
 
 def snapshot(
-    summary: Mapping[str, Any] | None = None, *, meta: Mapping | None = None
+    summary: Mapping[str, Any] | None = None,
+    *,
+    meta: Mapping | None = None,
+    memory: Mapping | None = None,
 ) -> dict:
     """A baseline snapshot from a ``summary()``-shaped dict (default:
-    the calling thread's installed recorder)."""
+    the calling thread's installed recorder). ``memory=`` attaches the
+    memory-ledger gate keys (ISSUE 18) — pass a ``Server.stats()``
+    ``memory`` block; only the gateable numerics are kept."""
     if summary is None:
         summary = core.summary()
     if not summary:
@@ -76,6 +81,19 @@ def snapshot(
         # The snapshot's percentiles describe a TRUNCATED buffer — carry
         # the fact so `obs diff` can refuse to gate on it (exit 2).
         out["dropped_events"] = int(summary["dropped_events"])
+    if memory:
+        # Memory-ledger gate keys (ISSUE 18): peak held bytes (gated —
+        # relative growth beyond tolerance) and the run's minimum KV
+        # headroom (reported). Stored only when the source block
+        # actually carried ledger numbers, so a pre-ledger snapshot
+        # diffs as "no memory section", never as a vacuous pass.
+        mem = {
+            k: memory[k]
+            for k in ("held_peak_bytes", "kv_headroom_min_pct", "platform")
+            if isinstance(memory.get(k), (int, float, str))
+        }
+        if isinstance(mem.get("held_peak_bytes"), (int, float)):
+            out["memory"] = mem
     if meta:
         out["meta"] = dict(meta)
     return out
@@ -203,15 +221,51 @@ def diff(
             util[f"{name}.{key}"] = entry
             if entry["regressed"]:
                 util_regressions.append(f"{name}.{key}")
+    # Memory keys (ISSUE 18): peak held-bytes GROWTH beyond tolerance
+    # is a regression (a capacity leak holds time steady while HBM
+    # climbs); the minimum-headroom drop is reported for context. Only
+    # numeric-on-both-sides — a snapshot without ledger data (pre-18
+    # baseline, or a non-serve workload) never gates vacuously.
+    mem: dict[str, dict] = {}
+    mem_regressions: list[str] = []
+    bm = base.get("memory") or {}
+    cm = cur.get("memory") or {}
+    b_peak, c_peak = bm.get("held_peak_bytes"), cm.get("held_peak_bytes")
+    if (
+        isinstance(b_peak, (int, float))
+        and isinstance(c_peak, (int, float))
+        and b_peak > 0
+    ):
+        growth = 100.0 * (c_peak - b_peak) / b_peak
+        entry = {
+            "base": int(b_peak),
+            "cur": int(c_peak),
+            "growth_pct": round(growth, 2),
+            "regressed": bool(growth > tolerance_pct),
+        }
+        mem["held_peak_bytes"] = entry
+        if entry["regressed"]:
+            mem_regressions.append("memory.held_peak_bytes")
+    b_head = bm.get("kv_headroom_min_pct")
+    c_head = cm.get("kv_headroom_min_pct")
+    if isinstance(b_head, (int, float)) and isinstance(c_head, (int, float)):
+        mem["kv_headroom_min_pct"] = {
+            "base": round(float(b_head), 2),
+            "cur": round(float(c_head), 2),
+        }
     out = {
         "tolerance_pct": tolerance_pct,
         "phases": phases,
         "missing_phases": sorted(set(bp) - set(cp)),
         "new_phases": sorted(set(cp) - set(bp)),
         "regressions": regressions,
-        "ok": not regressions and not util_regressions,
+        "ok": not regressions and not util_regressions
+        and not mem_regressions,
     }
     if util:
         out["utilization"] = util
         out["util_regressions"] = util_regressions
+    if mem:
+        out["memory"] = mem
+        out["memory_regressions"] = mem_regressions
     return out

@@ -401,6 +401,15 @@ def _live_line(registry, monitor, server, now: float) -> str:
             f" kvtok={g.get('kv_tokens_cached', 0.0):.0f}"
             f" shr={g.get('prefix_pages_shared', 0.0):.0f}"
         )
+    if "hbm_held_bytes" in g:
+        # Byte-exact memory view (ISSUE 18): total ledger-held HBM,
+        # the KV pool's held share, and the admission headroom — the
+        # same numbers a refused admit is annotated with.
+        line += (
+            f" hbm={g['hbm_held_bytes'] / 1e6:.1f}MB"
+            f" held={g.get('kv_held_bytes', 0.0) / 1e6:.1f}MB"
+            f" headroom={g.get('kv_headroom_pct', 0.0):.0f}%"
+        )
     bw = r.get("decode_hbm_bytes", {}).get("rate_per_s", 0.0)
     if bw:
         # Windowed utilization (ISSUE 8): the length-aware decode HBM

@@ -95,10 +95,12 @@ from mpit_tpu.ops.decode_attention import (
     pick_block_k,
 )
 from mpit_tpu.ops.lm_head import lm_head_sample, lm_head_verify
+from mpit_tpu.obs.memledger import MemLedger
 from mpit_tpu.serve.spec import (
     accept_emit,
     draft_distribution,
     modified_logits,
+    register_draft_store,
     verify_reference,
 )
 from mpit_tpu.serve.kvcache import (
@@ -111,7 +113,11 @@ from mpit_tpu.serve.kvcache import (
     kv_wire_bytes_per_row,
     paged_cache_specs,
 )
-from mpit_tpu.serve.weights import params_wire_bytes, quantize_gpt2_params
+from mpit_tpu.serve.weights import (
+    params_wire_bytes,
+    quantize_gpt2_params,
+    register_param_store,
+)
 
 __all__ = ["Engine", "sample_tokens"]
 
@@ -899,6 +905,72 @@ class Engine:
         self._kv_row_bytes = kv_wire_bytes_per_row(
             self.cfg.num_heads, self.cfg.head_dim, self.cache.k.dtype
         )
+        # ISSUE 18: the byte-exact HBM ledger. Every buffer this
+        # constructor pinned to the device registers ONCE — the weight
+        # store (int8 payload + scale rows at wire width), the KV cache
+        # buffers (target + draft, K + V + lengths), the draft weights
+        # (0 bytes when aliasing target leaves), per-slot step state —
+        # and the page allocator emits grant/free at every physical
+        # page transition, so `memledger.held()` decomposes total HBM
+        # with `grants − frees == held` exact. Buffer sizes come from
+        # the arrays' own nbytes (identical to the wire model for int8:
+        # q payload + f32 scales), so the ledger measures what was
+        # allocated, not what arithmetic predicts.
+        self.memledger = MemLedger(platform=platform)
+        register_param_store(self.memledger, self.params)
+        kv_buf = sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves((self.cache.k, self.cache.v))
+        )
+        lengths_bytes = self.cache.lengths.nbytes
+        draft_kv = 0
+        if self.draft_cache is not None:
+            draft_kv = sum(
+                leaf.nbytes
+                for leaf in jax.tree.leaves(
+                    (self.draft_cache.k, self.draft_cache.v)
+                )
+            )
+            lengths_bytes += self.draft_cache.lengths.nbytes
+        self.memledger.register(
+            "kv_pool", capacity_bytes=kv_buf + draft_kv + lengths_bytes
+        )
+        self.memledger.grant(
+            "kv_pool", kv_buf + lengths_bytes, kind="cache_buffers"
+        )
+        if self.spec_k:
+            register_draft_store(
+                self.memledger, self.draft_params,
+                target_params=self.params, kv_bytes=draft_kv,
+            )
+        self.memledger.grant(
+            "step_buffers", self.last_token.nbytes, kind="last_token"
+        )
+        self.slot_bytes = 0
+        self.page_bytes = 0
+        if self.paged:
+            # What one granted page occupies across ALL layers, K and
+            # V, target AND draft pool (shared block tables mean a page
+            # grant maps rows in both buffers) — the allocator's unit
+            # for the nested kv_pages / kv_cow_reserve decomposition.
+            self.page_bytes = (kv_buf + draft_kv) // self.num_pages
+            self.memledger.register(
+                "kv_pages",
+                capacity_bytes=self.num_pages * self.page_bytes,
+                nested_in="kv_pool",
+            )
+            self.memledger.register("kv_cow_reserve", nested_in="kv_pool")
+            self.allocator.memledger = self.memledger
+            self.allocator.page_bytes = self.page_bytes
+        else:
+            # Dense: capacity is slot-granular; the scheduler grants/
+            # frees one slot reservation per admission/retirement.
+            self.slot_bytes = (kv_buf + draft_kv) // self.slots
+            self.memledger.register(
+                "kv_slots",
+                capacity_bytes=self.slots * self.slot_bytes,
+                nested_in="kv_pool",
+            )
 
     # -- jitted step bodies -------------------------------------------------
     def _sample_last(self, params, out, gather_idx, key, temp, topk):
@@ -1687,3 +1759,13 @@ class Engine:
             )
         if self.paged:
             self.allocator.reset()
+        else:
+            # Dense slot reservations are the scheduler's grants; a
+            # reset drops them all (the paged arm's allocator.reset
+            # emits the equivalent kv_pages frees itself).
+            held = self.memledger.held("kv_slots")
+            if held:
+                self.memledger.free("kv_slots", held, kind="reset")
+        # Owner recency and exhaustion forensics describe the LAST run;
+        # static buffer grants persist (the buffers do too).
+        self.memledger.reset_transients()

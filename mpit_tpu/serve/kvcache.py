@@ -333,9 +333,29 @@ class PageAllocator:
         self.pages_per_slot = pages_per_slot
         self.slots = slots
         self.block_tables = np.zeros((slots, pages_per_slot), np.int32)
+        # ISSUE 18: the engine binds its MemLedger + the wire bytes one
+        # page occupies (all layers, K+V, target + draft pool) after
+        # construction; every PHYSICAL page transition below then emits
+        # a grant/free so ``kv_pages``/``kv_cow_reserve`` held-bytes
+        # track ``pages_in_use``/``reserved`` exactly. None = unwired
+        # (standalone allocator tests) — a no-op, not a crash.
+        self.memledger = None
+        self.page_bytes = 0.0
         self.reset()
 
     def reset(self) -> None:
+        if self.memledger is not None and self.pages_in_use:
+            # Return everything still held before the wipe — a reset
+            # mid-ledger must conserve, not orphan bytes (ISSUE 18).
+            self.memledger.free(
+                "kv_pages", self.pages_in_use * self.page_bytes,
+                kind="reset",
+            )
+            if self.reserved:
+                self.memledger.free(
+                    "kv_cow_reserve", self.reserved * self.page_bytes,
+                    kind="reset",
+                )
         self.block_tables[:] = 0
         self.refcount = np.zeros(self.num_pages, np.int64)
         self.free: list[int] = list(range(self.num_pages))[::-1]  # pop()=0 first
@@ -344,6 +364,11 @@ class PageAllocator:
         self._slot_pages: dict[int, list[int]] = {}
         self._index: dict[tuple[int, int], _PrefixEntry] = {}
         self._page_keys: dict[int, set] = {}  # page -> index keys citing it
+        # ISSUE 18 attribution inputs: who maps each slot and when each
+        # prefix entry was last used — query-time ground truth for the
+        # per-request/per-tenant roll-up and the eviction ranking.
+        self._slot_owner: dict[int, tuple] = {}  # slot -> (rid, tenant)
+        self._prefix_touch: dict[tuple, int] = {}  # index key -> tick
         # Stats (the scheduler's kv gauges + bench's prefix_hit_rate).
         self.cow_copies = 0
         self.prefix_hits = 0
@@ -398,14 +423,17 @@ class PageAllocator:
                 return n, entry
         return 0, None
 
-    def admit(self, slot: int, prompt, max_new_tokens: int):
+    def admit(self, slot: int, prompt, max_new_tokens: int, *,
+              owner=None, tenant=None, tick: int = 0):
         """Map pages for one request into ``slot``'s block table.
 
         Returns an :class:`AdmitPlan`, or ``None`` when the pool cannot
         hold the request right now (nothing is taken — the caller keeps
         it queued and retries after a retirement frees pages). Raises
         only on requests that could NEVER fit (caller bug — validated
-        at submit)."""
+        at submit). ``owner``/``tenant``/``tick`` annotate the memory
+        ledger's grants (ISSUE 18) — attribution metadata only, never
+        part of the capacity decision."""
         prompt = tuple(int(t) for t in prompt)
         need_total = self.pages_for(len(prompt), max_new_tokens)
         if need_total > self.pages_per_slot:
@@ -440,16 +468,40 @@ class PageAllocator:
             self.reserved += 1
         mapping = shared_pages + fresh
         self._slot_pages[slot] = mapping
+        self._slot_owner[slot] = (owner, tenant)
         self.block_tables[slot] = 0  # no stale entries from the last tenant
         self.block_tables[slot, : len(mapping)] = mapping
+        if self.memledger is not None:
+            # Only the FRESH pops are new physical occupancy; a shared
+            # mapping is a refcount on pages already granted. The COW
+            # reserve is held capacity too — it gates admission.
+            if fresh:
+                self.memledger.grant(
+                    "kv_pages", len(fresh) * self.page_bytes,
+                    owner=owner, tenant=tenant, tick=tick, kind="admit",
+                )
+            elif owner is not None:
+                self.memledger.touch(
+                    owner, tick=tick, tenant=tenant, state="admit"
+                )
+            if partial_shared:
+                self.memledger.grant(
+                    "kv_cow_reserve", self.page_bytes,
+                    owner=owner, tenant=tenant, tick=tick,
+                    kind="cow_reserve",
+                )
         self.admissions += 1
         if shared_tokens:
             self.prefix_hits += 1
+            # A hit refreshes the entry's recency — a prefix actively
+            # being re-mapped is NOT an eviction candidate (ISSUE 18).
+            hashes = _prefix_hashes(prompt[:shared_tokens])
+            self._prefix_touch[(shared_tokens, hashes[-1])] = tick
         self.shared_tokens_total += shared_tokens
         self.prompt_tokens_total += len(prompt)
         return AdmitPlan(shared_tokens=shared_tokens, pages=tuple(mapping))
 
-    def register_prefix(self, slot: int, prompt) -> None:
+    def register_prefix(self, slot: int, prompt, *, tick: int = 0) -> None:
         """Index ``slot``'s now-fully-prefilled prompt so later admits
         can share it: one entry per page-aligned prefix plus the full
         prompt (covering its partially-filled last page). Call only
@@ -473,6 +525,7 @@ class PageAllocator:
             self._index[key] = _PrefixEntry(
                 tokens=prompt[:n], pages=pages
             )
+            self._prefix_touch[key] = tick
             for p in pages:
                 self._page_keys.setdefault(p, set()).add(key)
 
@@ -507,9 +560,21 @@ class PageAllocator:
                 "COW with an empty free list — reservation accounting bug"
             )
         dst = self.free.pop()
+        if self.memledger is not None:
+            # The copy's destination is new physical occupancy, paid
+            # for by the reservation this mapper made at admit.
+            owner, tenant = self._slot_owner.get(slot, (None, None))
+            self.memledger.grant(
+                "kv_pages", self.page_bytes,
+                owner=owner, tenant=tenant, kind="cow_copy",
+            )
         if self._cow_reserve.get(page, 0) > 0:
             self._cow_reserve[page] -= 1
             self.reserved -= 1
+            if self.memledger is not None:
+                self.memledger.free(
+                    "kv_cow_reserve", self.page_bytes, kind="cow_copy"
+                )
         self.refcount[page] -= 1
         self.refcount[dst] = 1
         self._trim_reserve(page)
@@ -533,6 +598,11 @@ class PageAllocator:
         if excess > 0:
             self._cow_reserve[page] -= excess
             self.reserved -= excess
+            if self.memledger is not None:
+                self.memledger.free(
+                    "kv_cow_reserve", excess * self.page_bytes,
+                    kind="trim_reserve",
+                )
 
     # -- release ------------------------------------------------------------
     def slot_page_stats(self, slot: int) -> tuple:
@@ -550,14 +620,25 @@ class PageAllocator:
         """Unmap ``slot``'s pages; pages at refcount 0 return to the
         free list and any prefix-index entries citing them die (their
         advertised K/V is about to be recycled)."""
+        owner, _ = self._slot_owner.pop(slot, (None, None))
+        released = 0
         for p in self._slot_pages.pop(slot, []):
             self.refcount[p] -= 1
             self._trim_reserve(p)
             if self.refcount[p] == 0:
                 for key in self._page_keys.pop(p, ()):  # invalidate
                     entry = self._index.pop(key, None)
+                    self._prefix_touch.pop(key, None)
                     if entry is not None:
                         for q in entry.pages:
                             if q != p and q in self._page_keys:
                                 self._page_keys[q].discard(key)
                 self.free.append(p)
+                released += 1
+        if self.memledger is not None and released:
+            # Only pages hitting refcount 0 return physical occupancy;
+            # dropping a refcount on a still-shared page frees nothing.
+            self.memledger.free(
+                "kv_pages", released * self.page_bytes,
+                owner=owner, kind="free_slot",
+            )

@@ -222,6 +222,12 @@ class _Live:
     # resumed greedy output bit-match). ``preempts`` bounds thrash.
     feed: list | None = None
     preempts: int = 0
+    # Memory-ledger recency (ISSUE 18): the last tick this request's
+    # cache bytes were touched (bind / prefill chunk / decode emit) and
+    # the tick a preemption parked it — what the eviction-candidate
+    # ranking orders by (coldest first).
+    last_touch: int = 0
+    park_tick: int = 0
 
     def feed_tokens(self) -> list:
         """What prefill feeds the device: the prompt, or the resume
@@ -380,6 +386,13 @@ class Server:
         self._concurrency_peak = 0
         self._truncated = False  # a run stopped with work still pending
         self._pool_exhausted = False  # edge-trigger for the obs instant
+        # The HBM memory ledger (ISSUE 18): the engine registered every
+        # buffer at construction; the server reads headroom at every
+        # admission verdict, tracks the run's peak/min watermarks, and
+        # rolls the whole byte decomposition into stats()["memory"].
+        self._memledger = getattr(engine, "memledger", None)
+        self._held_peak = 0
+        self._headroom_min_pct: float | None = None
         # Per-slot sampling-control arrays (host; refreshed on admit/retire).
         s = engine.slots
         self._temp = np.zeros((s,), np.float32)
@@ -511,8 +524,13 @@ class Server:
             self.shed_causes[cause] = self.shed_causes.get(cause, 0) + 1
             obs.counter("serve_shed")
             obs.counter(f"serve_shed_{cause}")
+            # The headroom numbers at the refusal (ISSUE 18): a shed
+            # verdict annotated with the bytes that were (not)
+            # available when it was made — the causal event grows the
+            # memory dimension the way ISSUE 16 grew the projection one.
+            headroom = self._kv_headroom()
             obs.instant("request_shed", cause=cause, reason=reason,
-                        queue_depth=self._qdepth(),
+                        queue_depth=self._qdepth(), **headroom,
                         **self._span_attrs(req))
             if self.stream is not None:
                 self.stream.inc("serve_shed")
@@ -520,7 +538,7 @@ class Server:
             if self._ledger is not None:
                 self._ledger.event(
                     req.rid, "shed", reason=reason,
-                    queue_depth=self._qdepth(),
+                    queue_depth=self._qdepth(), **headroom,
                 )
                 self._ledger.retire(req.rid, status="shed", reason=reason)
             return False
@@ -597,7 +615,11 @@ class Server:
                 break
             slot = self.free[-1]
             feed = live.feed_tokens()
-            plan = alloc.admit(slot, feed, live.remaining_new())
+            plan = alloc.admit(
+                slot, feed, live.remaining_new(),
+                owner=live.req.rid, tenant=live.req.tenant or None,
+                tick=self.tick,
+            )
             if plan is None:
                 # Pool full RIGHT NOW (nothing was taken) — back to the
                 # queue head; retry after a retirement (or a preemption)
@@ -609,16 +631,32 @@ class Server:
                 self._restore_queued(live)
                 if self._try_preempt(now):
                     continue  # freed pages; the restored head retries
+                if self._ledger is not None:
+                    # The refused admit's causal event carries the
+                    # headroom numbers that refused it (ISSUE 18).
+                    self._ledger.event(
+                        live.req.rid, "admit_blocked", tick=self.tick,
+                        need_pages=alloc.pages_for(
+                            len(feed), live.remaining_new()
+                        ),
+                        free_pages=alloc.free_pages,
+                        **self._kv_headroom(),
+                    )
                 if not self._pool_exhausted:
                     self._pool_exhausted = True
-                    obs.instant(
-                        "kv_pool_exhausted",
-                        free_pages=alloc.free_pages,
-                        queued=self._qdepth(),
-                    )
+                    # Exhaustion forensics (ISSUE 18 tentpole b): the
+                    # ranked top-holders table — who holds the pool the
+                    # refused head needed — as a structured instant,
+                    # retained on the ledger for the end-of-run
+                    # snapshot and the `obs capacity` CLI.
+                    dump = self._exhaustion_dump()
+                    if self._memledger is not None:
+                        self._memledger.note_exhaustion(dump)
+                    obs.instant("kv_pool_exhausted", **dump)
                 break
             self.free.pop()
             self._pool_exhausted = False  # an admit fit: episode over
+            live.last_touch = self.tick
             # The write floor is the shared-token count; the forward
             # re-runs at least the LAST feed token (its logits seed
             # the next output token), so the feed base is capped one
@@ -696,6 +734,15 @@ class Server:
         live.feed = list(live.req.prompt) + [int(t) for t in live.tokens]
         live.base = 0
         live.floor = 0
+        live.park_tick = self.tick
+        if self._memledger is not None:
+            # Parked = cold by definition: the owner stays on the
+            # recency index (state flips to "parked") so the eviction
+            # ranking can surface it, coldest first (ISSUE 18).
+            self._memledger.touch(
+                live.req.rid, tick=self.tick,
+                tenant=live.req.tenant or None, state="parked",
+            )
         obs.counter("serve_preemptions")
         # The displacing rid (ISSUE 16): the head whose projected TTFT
         # miss justified this eviction — recorded by wants_preemption,
@@ -801,10 +848,13 @@ class Server:
                         chunk=n, dur_s=t_first - now, t=t_first,
                     )
         for slot in self.prefilling:
-            self.prefilling[slot].base += int(chunk_lens[slot])
+            live = self.prefilling[slot]
+            live.base += int(chunk_lens[slot])
+            if chunk_lens[slot]:
+                live.last_touch = self.tick
         for slot, live in finishing:
             del self.prefilling[slot]
-            alloc.register_prefix(slot, live.feed_tokens())
+            alloc.register_prefix(slot, live.feed_tokens(), tick=self.tick)
             if live.tokens:
                 # Resumed after a preemption: this chunk's sampled
                 # token IS the decode step the eviction displaced —
@@ -860,6 +910,16 @@ class Server:
             admit[slot] = True
             self._temp[slot] = live.req.temperature
             self._topk[slot] = live.req.top_k
+            live.last_touch = self.tick
+            if self._memledger is not None:
+                # Dense capacity is slot-granular (ISSUE 18): one slot
+                # reservation granted per admission, freed at retire —
+                # the dense twin of the allocator's page grants.
+                self._memledger.grant(
+                    "kv_slots", self.engine.slot_bytes,
+                    owner=live.req.rid, tenant=live.req.tenant or None,
+                    tick=self.tick, kind="admit",
+                )
             if self._ledger is not None:
                 self._ledger.event(
                     live.req.rid, "slot_bind", slot=slot, tick=self.tick,
@@ -931,6 +991,13 @@ class Server:
             # mask defines validity), prefix-index entries whose pages
             # died are invalidated.
             self.engine.allocator.free_slot(slot)
+        elif self._memledger is not None:
+            self._memledger.free(
+                "kv_slots", self.engine.slot_bytes,
+                owner=req.rid, kind="retire",
+            )
+        if self._memledger is not None:
+            self._memledger.forget(req.rid)
         self.free.append(slot)
         self._temp[slot] = 0.0
         self._topk[slot] = 0
@@ -1115,6 +1182,7 @@ class Server:
             self.live[slot].tokens.extend(
                 int(t) for t in emit[slot, :n]
             )
+            self.live[slot].last_touch = self.tick
             self._maybe_retire(slot, now)
 
     def _decode_tick(self) -> None:
@@ -1217,6 +1285,7 @@ class Server:
                 )
         for slot in list(self.live):
             self.live[slot].tokens.append(int(toks[slot]))
+            self.live[slot].last_touch = self.tick
             self._maybe_retire(slot, now)
 
     def _pending(self) -> bool:
@@ -1238,6 +1307,7 @@ class Server:
         obs.gauge("kv_tokens_cached", kv_tokens)
         if self.stream is not None:
             self.stream.set_gauge("kv_tokens_cached", kv_tokens)
+        self._memory_gauges(kv_tokens)
         if not self._paged:
             return
         alloc = self.engine.allocator
@@ -1251,6 +1321,132 @@ class Server:
         if self.stream is not None:
             self.stream.set_gauge("kv_pool_occupancy", occ)
             self.stream.set_gauge("prefix_pages_shared", float(shared))
+
+    def _memory_gauges(self, kv_tokens: float) -> None:
+        """Live headroom / watermark / fragmentation gauges (ISSUE 18
+        tentpole a): total held bytes, the KV pool's held bytes and
+        headroom, and internal fragmentation — granted page capacity
+        not covered by cached tokens (tail rows of partially filled
+        pages). Recorder gauges AND the rolling stream windows (the
+        serve CLI's ``hbm=/held=/headroom=`` fields); the run's peak
+        held and minimum headroom are tracked here, once per tick."""
+        ml = self._memledger
+        if ml is None:
+            return
+        held = ml.held()
+        self._held_peak = max(self._held_peak, int(held))
+        head = self._kv_headroom()
+        gauges = {"hbm_held_bytes": float(held)}
+        sub = "kv_pages" if self._paged else "kv_slots"
+        kv_held = ml.held(sub) + (
+            ml.held("kv_cow_reserve") if self._paged else 0.0
+        )
+        gauges["kv_held_bytes"] = float(kv_held)
+        if "kv_headroom_pct" in head:
+            pct = head["kv_headroom_pct"]
+            self._headroom_min_pct = (
+                pct
+                if self._headroom_min_pct is None
+                else min(self._headroom_min_pct, pct)
+            )
+            gauges["kv_headroom_pct"] = pct
+        if self._paged:
+            in_use = self.engine.allocator.pages_in_use
+            granted_tokens = in_use * self.engine.page_size
+            gauges["kv_frag_pct"] = (
+                round(100.0 * (1.0 - kv_tokens / granted_tokens), 2)
+                if granted_tokens
+                else 0.0
+            )
+        for name, val in gauges.items():
+            obs.gauge(name, val)
+            if self.stream is not None:
+                self.stream.set_gauge(name, val)
+
+    def _kv_headroom(self) -> dict:
+        """KV capacity headroom RIGHT NOW — the bytes an admission
+        verdict had to work with (annotated onto sheds and blocked
+        admits). Paged: free grantable pages × page bytes (COW reserve
+        excluded — those bytes are promised). Dense: free slot
+        reservations. Empty when the engine has no ledger."""
+        ml = self._memledger
+        if ml is None:
+            return {}
+        sub = "kv_pages" if self._paged else "kv_slots"
+        cap = ml.capacity(sub)
+        if not cap:
+            return {}
+        held = ml.held(sub) + (
+            ml.held("kv_cow_reserve") if self._paged else 0.0
+        )
+        headroom = cap - held
+        return {
+            "kv_headroom_bytes": int(headroom),
+            "kv_headroom_pct": round(100.0 * headroom / cap, 2),
+            "hbm_held_bytes": int(ml.held()),
+        }
+
+    def _exhaustion_dump(self) -> dict:
+        """The ranked top-holders table for a pool-exhaustion edge
+        (ISSUE 18 tentpole b): per-request exclusive bytes (what
+        evicting each would actually return), per-tenant totals, the
+        subsystem decomposition, COW reserve, and the prefix-index
+        health counts — everything a "why won't this admit" forensic
+        needs, computed from allocator ground truth at the edge."""
+        alloc = self.engine.allocator
+        pb = self.engine.page_bytes
+        holders = []
+        for slot, live in list(self.live.items()) + list(
+            self.prefilling.items()
+        ):
+            owned, shared = alloc.slot_page_stats(slot)
+            holders.append({
+                "rid": live.req.rid,
+                "tenant": live.req.tenant or "",
+                "bytes": int(owned * pb),
+                "shared_pages": shared,
+                "last_touch_tick": live.last_touch,
+            })
+        holders.sort(key=lambda e: (-e["bytes"], str(e["rid"])))
+        tenants: dict[str, int] = {}
+        for h in holders:
+            tenants[h["tenant"]] = tenants.get(h["tenant"], 0) + h["bytes"]
+        sole, dead = self._prefix_entry_counts()
+        out = {
+            "tick": self.tick,
+            "free_pages": alloc.free_pages,
+            "queued": self._qdepth(),
+            "top_holders": holders[:8],
+            "tenants": dict(
+                sorted(tenants.items(), key=lambda kv: -kv[1])
+            ),
+            "cow_reserve_bytes": int(alloc.reserved * pb),
+            "sole_reader_prefix_entries": sole,
+            # 0 by construction (entries die with their pages) —
+            # reported so a future allocator change that breaks the
+            # invariant shows up as leaked dead entries, not silence.
+            "dead_prefix_entries": dead,
+        }
+        if self._memledger is not None:
+            out["subsystems"] = self._memledger.decompose()
+        out.update(self._kv_headroom())
+        return out
+
+    def _prefix_entry_counts(self) -> tuple[int, int]:
+        """(sole-reader, dead) prefix-index entry counts: entries whose
+        pages are all refcount 1 (only the registrant still maps them —
+        reclaimable by retiring one idle slot) and entries citing a
+        page at refcount 0 (impossible by construction; counted so a
+        regression surfaces)."""
+        alloc = self.engine.allocator
+        sole = dead = 0
+        for entry in alloc._index.values():
+            refs = [int(alloc.refcount[p]) for p in entry.pages]
+            if any(r == 0 for r in refs):
+                dead += 1
+            elif all(r == 1 for r in refs):
+                sole += 1
+        return sole, dead
 
     def _run_tick(self) -> None:
         """One loop iteration: admit, prefill chunk (paged), gauges,
@@ -1406,6 +1602,158 @@ class Server:
                     e["ttft_p95_s"] = round(sk.quantile(0.95), 6)
         return dict(sorted(out.items()))
 
+    def _eviction_candidates(self, cap: int = 16) -> list:
+        """Ranked list of what an eviction policy SHOULD reclaim first
+        (ISSUE 18 tentpole c — the ROADMAP inventory item consumes
+        this, ordered coldest-first by last-touch tick):
+
+        - ``parked_victim``: a preempted request sitting in a policy
+          queue. Its pages are already free — the bytes figure is the
+          claim its re-admission will make (what NOT resuming it
+          saves), stamped with the tick the preemption parked it.
+        - ``idle_tail``: a live slot's exclusively-owned bytes. Live
+          slots touch their cache every decode tick, so these rank
+          hottest (last) — correct: evicting a decoding request is the
+          most disruptive choice, listed only as the final resort.
+        - ``sole_reader_prefix``: a prefix-index entry whose pages are
+          all refcount 1 — nobody shares it anymore; retiring its one
+          mapper returns the whole run. Nested page-aligned entries of
+          the same registration are deduped to the longest.
+        """
+        pb = self.engine.page_bytes
+        out = []
+        if self.policy is not None and pb:
+            alloc = self.engine.allocator
+            for st in self.policy._tiers.values():
+                for q in st.queues.values():
+                    for live in q:
+                        if live.feed is None:
+                            continue  # fresh submit, holds nothing yet
+                        pages = alloc.pages_for(
+                            len(live.feed), live.remaining_new()
+                        )
+                        out.append({
+                            "kind": "parked_victim",
+                            "rid": live.req.rid,
+                            "tenant": live.req.tenant or "",
+                            "bytes": int(pages * pb),
+                            "last_touch_tick": live.park_tick,
+                        })
+        if self._paged and pb:
+            alloc = self.engine.allocator
+            for slot, live in self.live.items():
+                owned, _ = alloc.slot_page_stats(slot)
+                out.append({
+                    "kind": "idle_tail",
+                    "rid": live.req.rid,
+                    "tenant": live.req.tenant or "",
+                    "bytes": int(owned * pb),
+                    "last_touch_tick": live.last_touch,
+                })
+            best: dict[int, tuple] = {}
+            for key, entry in alloc._index.items():
+                if not entry.pages:
+                    continue
+                if any(int(alloc.refcount[p]) != 1 for p in entry.pages):
+                    continue
+                first = entry.pages[0]
+                if first not in best or key[0] > best[first][0][0]:
+                    best[first] = (key, entry)
+            for key, entry in best.values():
+                out.append({
+                    "kind": "sole_reader_prefix",
+                    "key": f"prefix[{key[0]}t]",
+                    "bytes": int(len(entry.pages) * pb),
+                    "last_touch_tick": alloc._prefix_touch.get(key, 0),
+                })
+        elif not self._paged and self.engine.slot_bytes:
+            for live in self.live.values():
+                out.append({
+                    "kind": "idle_tail",
+                    "rid": live.req.rid,
+                    "tenant": live.req.tenant or "",
+                    "bytes": int(self.engine.slot_bytes),
+                    "last_touch_tick": live.last_touch,
+                })
+        out.sort(key=lambda c: (c["last_touch_tick"],
+                                str(c.get("rid", c.get("key", "")))))
+        return out[:cap]
+
+    def _memory_stats(self) -> dict:
+        """The ``stats()["memory"]`` block (ISSUE 18): byte-exact held
+        decomposition + conservation verdict from the ledger, live KV
+        headroom, per-request/per-tenant attribution computed from
+        allocator ground truth, the eviction-candidate ranking, and the
+        device reconciliation (modeled-only off TPU — the roofline
+        honesty rule). ``source: memledger`` is the marker the
+        ``obs capacity`` CLI keys on."""
+        ml = self._memledger
+        if ml is None:
+            return {}
+        out = {
+            "source": "memledger",
+            "platform": ml.platform,
+            "held_bytes": int(ml.held()),
+            "held_peak_bytes": int(max(self._held_peak, int(ml.held()))),
+            "held_by_subsystem": ml.decompose(),
+            "conservation": ml.conservation(),
+        }
+        sub = "kv_pages" if self._paged else "kv_slots"
+        cap = ml.capacity(sub)
+        if cap:
+            out["kv_capacity_bytes"] = int(cap)
+            out.update(self._kv_headroom())
+            out.pop("hbm_held_bytes", None)  # duplicate of held_bytes
+        if self._headroom_min_pct is not None:
+            out["kv_headroom_min_pct"] = self._headroom_min_pct
+        per_req: dict[str, dict] = {}
+        per_tenant: dict[str, int] = {}
+        if self._paged and self.engine.page_bytes:
+            alloc = self.engine.allocator
+            pb = self.engine.page_bytes
+            for slot, live in list(self.live.items()) + list(
+                self.prefilling.items()
+            ):
+                owned, shared = alloc.slot_page_stats(slot)
+                per_req[str(live.req.rid)] = {
+                    "bytes": int(owned * pb),
+                    "shared_pages": shared,
+                    "tenant": live.req.tenant or "",
+                }
+            shared_pages = int((alloc.refcount >= 2).sum())
+            out["shared_bytes"] = int(shared_pages * pb)
+        elif not self._paged and self.engine.slot_bytes:
+            for live in self.live.values():
+                per_req[str(live.req.rid)] = {
+                    "bytes": int(self.engine.slot_bytes),
+                    "shared_pages": 0,
+                    "tenant": live.req.tenant or "",
+                }
+        for e in per_req.values():
+            t = e["tenant"]
+            per_tenant[t] = per_tenant.get(t, 0) + e["bytes"]
+        if per_req:
+            out["per_request"] = dict(
+                sorted(per_req.items(), key=lambda kv: -kv[1]["bytes"])
+            )
+            out["per_tenant"] = dict(
+                sorted(per_tenant.items(), key=lambda kv: -kv[1])
+            )
+        ev = self._eviction_candidates()
+        if ev:
+            out["eviction_candidates"] = ev
+        device = None
+        if getattr(self.engine, "platform", None) == "tpu":
+            import jax
+
+            device = jax.devices()[0]
+        out["reconciliation"] = ml.reconcile(device)
+        snap = ml.snapshot()
+        if "exhaustion" in snap:
+            out["exhaustion"] = snap["exhaustion"]
+            out["exhaustions"] = snap["exhaustions"]
+        return out
+
     def stats(self) -> dict:
         """Host-side serving roll-up (the obs summary carries the
         span-derived histograms; this is the request-math view)."""
@@ -1504,6 +1852,9 @@ class Server:
         tenants = self._tenant_rollup()
         if tenants:
             out["tenants"] = tenants
+        memory = self._memory_stats()
+        if memory:
+            out["memory"] = memory
         if done:
             lat = np.asarray([c.latency_s for c in done])
             ttft = np.asarray([c.ttft_s for c in done])

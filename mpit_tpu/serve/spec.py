@@ -50,10 +50,41 @@ __all__ = [
     "accept_emit",
     "draft_distribution",
     "modified_logits",
+    "register_draft_store",
     "verify_reference",
 ]
 
 _NEG_BIG = -1e30  # exp underflows to exactly 0.0 in f32 (kernel idiom)
+
+
+def register_draft_store(
+    memledger, draft_params, *, target_params=None, kv_bytes: float = 0.0
+) -> float:
+    """Register the speculative engine's HBM footprint with the memory
+    ledger (ISSUE 18). The draft is the one subsystem whose weight
+    bytes are CONDITIONALLY real: a
+    :func:`~mpit_tpu.serve.weights.draft_from_target` draft aliases
+    target leaves (0 new bytes — granting them would double-count the
+    target store against the device allocator), while a separately
+    quantized or separately checkpointed draft holds its own buffers —
+    so the grant counts only leaves NOT aliasing ``target_params``.
+    The draft KV cache (``kv_bytes``) is always its own buffer — paged
+    drafts mirror the target pool's page geometry (same block tables,
+    separate arrays) — and lands on the ``kv_pool`` line, where the
+    per-page ``page_bytes`` already carries the draft term. Returns
+    the granted draft-weight bytes; ``memledger=None`` is the unwired
+    no-op arm."""
+    if memledger is None:
+        return 0.0
+    from mpit_tpu.serve.weights import register_param_store
+
+    granted = register_param_store(
+        memledger, draft_params,
+        subsystem="draft_weights", alias_of=target_params,
+    )
+    if kv_bytes:
+        memledger.grant("kv_pool", float(kv_bytes), kind="draft_kv")
+    return granted
 
 
 def modified_logits(logits, temperature, top_k):

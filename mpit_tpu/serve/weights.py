@@ -213,6 +213,46 @@ def params_wire_bytes(params) -> float:
     return total
 
 
+def register_param_store(
+    memledger, params, *, subsystem: str = "weights", alias_of=None
+) -> float:
+    """Register one param store's HBM footprint with the memory ledger
+    (ISSUE 18): ONE grant of the tree's wire bytes — int8 leaves at
+    int8 + scale-row width, dense leaves at dtype width — under
+    ``subsystem``. Leaves that ALIAS a leaf of ``alias_of`` (the
+    :func:`draft_from_target` reference-sharing case, and the quantizer
+    sharing unchanged leaves) cost nothing: the bytes are already on
+    the parent store's ledger line, and granting them twice would break
+    the conservation-vs-device reconciliation. Returns the granted
+    bytes. ``memledger=None`` is the unwired no-op arm."""
+    if memledger is None:
+        return 0.0
+    shared_ids = set()
+    if alias_of is not None:
+        for leaf in jax.tree.leaves(
+            alias_of, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+        ):
+            shared_ids.add(id(leaf))
+            if isinstance(leaf, QuantizedTensor):
+                shared_ids.add(id(leaf.q))
+    total = 0.0
+    leaves = jax.tree.leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
+    for leaf in leaves:
+        if id(leaf) in shared_ids or (
+            isinstance(leaf, QuantizedTensor) and id(leaf.q) in shared_ids
+        ):
+            continue
+        if isinstance(leaf, QuantizedTensor):
+            total += weight_wire_bytes(leaf.shape, "int8")
+        elif hasattr(leaf, "dtype"):
+            total += weight_wire_bytes(leaf.shape, leaf.dtype)
+    memledger.register(subsystem, capacity_bytes=total)
+    memledger.grant(subsystem, total, kind="param_store")
+    return total
+
+
 def draft_from_target(params: Mapping, cfg: GPT2Config, num_layers: int):
     """Layer-truncated self-draft (ISSUE 13): the first ``num_layers``
     transformer blocks of a target checkpoint, sharing its embeddings,

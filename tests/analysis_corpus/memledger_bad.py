@@ -1,0 +1,17 @@
+"""Corpus: memledger-seam fires exactly once — a marked allocation
+seam that moves physical pages (here: slot free) without emitting a
+memory-ledger event leaves the freed bytes attributed forever, and the
+conservation invariant (grants − frees == held) breaks for every
+capacity verdict downstream."""
+
+
+# analysis: memledger-seam
+def free_slot(alloc, slot):  # VIOLATION
+    pages = alloc.slot_pages.pop(slot, ())
+    released = 0
+    for p in pages:
+        alloc.refcount[p] -= 1
+        if alloc.refcount[p] == 0:
+            alloc.free.append(p)
+            released += 1
+    return released

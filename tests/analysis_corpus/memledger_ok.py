@@ -1,0 +1,33 @@
+"""Corpus false-positive guards for memledger-seam: a marked seam that
+emits through the guarded memledger idiom, a marked seam whose
+suppression names where the bytes ARE accounted, and an unmarked
+query helper that moves no bytes at all."""
+
+
+# analysis: memledger-seam
+def free_slot(alloc, slot):
+    pages = alloc.slot_pages.pop(slot, ())
+    released = 0
+    for p in pages:
+        alloc.refcount[p] -= 1
+        if alloc.refcount[p] == 0:
+            alloc.free.append(p)
+            released += 1
+    if alloc.memledger is not None and released:  # guarded emit: fine
+        alloc.memledger.free(
+            "kv_pages", released * alloc.page_bytes, kind="free_slot"
+        )
+    return released
+
+
+# The buffers are granted once by the engine's constructor seam.
+# analysis: memledger-seam
+def bind_pool(alloc, memledger, page_bytes):  # analysis: allow(memledger-seam)
+    alloc.page_bytes = page_bytes
+    return memledger
+
+
+def slot_page_stats(alloc, slot):  # unmarked query, no bytes move: fine
+    pages = alloc.slot_pages.get(slot, ())
+    owned = sum(1 for p in pages if alloc.refcount[p] == 1)
+    return owned, len(pages) - owned

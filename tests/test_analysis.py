@@ -57,6 +57,7 @@ class TestCorpusRulesFire:
             ("util_gate_bad.py", "util_gate_ok.py", "unlabeled-utilization"),
             ("thread_bind_bad.py", "thread_bind_ok.py", "thread-bind"),
             ("ledger_seam_bad.py", "ledger_seam_ok.py", "ledger-seam"),
+            ("memledger_bad.py", "memledger_ok.py", "memledger-seam"),
             ("kernel_dma_bad.py", "kernel_dma_ok.py", "kernel-dma-balance"),
             ("kernel_ring_bad.py", None, "kernel-ring-order"),
         ],
@@ -81,6 +82,7 @@ class TestCorpusRulesFire:
             ("util_gate_bad.py", "unlabeled-utilization"),
             ("thread_bind_bad.py", "thread-bind"),
             ("ledger_seam_bad.py", "ledger-seam"),
+            ("memledger_bad.py", "memledger-seam"),
             ("kernel_ring_bad.py", "kernel-ring-order"),
         ]:
             _, violations = run_static([corpus(name)], rules={rule})
@@ -94,7 +96,7 @@ class TestCorpusRulesFire:
 
     def test_whole_corpus_exactly_one_violation_per_rule(self):
         """The corpus README pin: analyzing the whole corpus directory
-        yields exactly the eight seeded violations — one per static
+        yields exactly the nine seeded violations — one per static
         rule, nothing from the ok twins."""
         code, violations = run_static([CORPUS])
         assert code == 1
@@ -103,8 +105,8 @@ class TestCorpusRulesFire:
             [
                 "host-sync-in-hot-seam", "jit-in-hot-seam",
                 "determinism-seam", "unlabeled-utilization",
-                "thread-bind", "ledger-seam", "kernel-dma-balance",
-                "kernel-ring-order",
+                "thread-bind", "ledger-seam", "memledger-seam",
+                "kernel-dma-balance", "kernel-ring-order",
             ]
         ), [v.format() for v in violations]
         assert all("_bad.py" in v.path for v in violations)

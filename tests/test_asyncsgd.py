@@ -122,6 +122,7 @@ class TestGPT2:
         "--log-every", "5",
     ]
 
+    @pytest.mark.slow  # tier-1 wall guard (round 18): heavy soak
     def test_shard_map_tier_learns(self):
         out = gpt2.main(["--steps", "20", *self.TINY])
         assert out["tier"] == "shard_map+zero1"

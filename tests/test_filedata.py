@@ -144,6 +144,7 @@ class TestWorkloadIntegration:
         with pytest.raises(SystemExit, match="expects"):
             app.main(["--data-dir", d, "--steps", "1"])
 
+    @pytest.mark.slow  # tier-1 wall guard (round 18): heavy soak
     def test_gpt2_app_trains_from_disk(self, tmp_path):
         """LM real-data path: bigram-structured token file; loss falls
         below the uniform baseline."""

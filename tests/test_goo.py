@@ -90,6 +90,7 @@ class TestElasticAverage:
             w = optax.apply_updates(w, updates)
         np.testing.assert_allclose(np.asarray(w), x, rtol=1e-5)
 
+    @pytest.mark.slow  # tier-1 wall guard (round 18): heavy soak
     def test_distributed_easgd_matches_numpy_sim(self, world8):
         # N workers with different local objectives (worker i pulls toward
         # c_i), coupled through the elastic center — the reference's

@@ -131,6 +131,7 @@ class TestSpecGreedyParity:
         )
         assert out == ref
 
+    @pytest.mark.slow  # tier-1 wall guard (round 18): parity soak
     def test_paged_spec_bitmatch_with_prefix_sharing(
         self, params, dparams, baseline
     ):
@@ -173,6 +174,7 @@ class TestSpecGreedyParity:
             st = server.stats()
             assert st["draft_acceptance_rate"] == 1.0, kw
 
+    @pytest.mark.slow  # tier-1 wall guard (round 18): heavy soak
     def test_spec_k3_bitmatch(self, params, dparams, baseline):
         """Parity is k-independent (a different k only changes how much
         is drafted per tick, never what is emitted)."""
@@ -271,6 +273,7 @@ class TestPagedRollbackEdges:
         assert out == ref
         assert eng.allocator.cow_copies >= 1
 
+    @pytest.mark.slow  # tier-1 wall guard (round 18): parity soak
     def test_spec_across_preempt_resume(self, params, dparams):
         """Park a mid-generation speculative request (pages freed —
         draft pool rides the same tables), resume through chunked
@@ -619,6 +622,7 @@ class TestSpecValidation:
         with pytest.raises(ValueError, match="num_layers"):
             draft_from_target(params, CFG, 2)
 
+    @pytest.mark.slow  # tier-1 wall guard (round 18): heavy soak
     def test_cli_draft_flag_validation(self):
         from mpit_tpu.serve.__main__ import main
 

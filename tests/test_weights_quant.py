@@ -299,6 +299,7 @@ class TestQuantizedParamStore:
 
 
 class TestQuantizedWeightServing:
+    @pytest.mark.slow  # tier-1 wall guard (round 18): heavy soak
     def test_greedy_agreement_and_oracle_bitmatch(self, trained,
                                                   engines):
         """The ISSUE 17 quality gate, ONE int8 batch serving both
