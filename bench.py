@@ -2976,6 +2976,122 @@ def bench_mnist_easgd(steps: int = 120, replicas: int = 2):
     }
 
 
+def bench_gpt2_fleet(
+    prompt_len: int = 16,
+    max_new: int = 48,
+    requests: int = 16,
+    decode_counts: tuple = (1, 2),
+    slots: int = 4,
+    max_len: int = 96,
+):
+    """The disaggregated serving fleet's throughput record (ISSUE 19):
+    router + 1 prefill worker + a swept number of decode workers on the
+    compat layer, the SAME seeded request set at every point, KV pages
+    shipped prefill → decode over ``Comm_dup("fleet-kv")``.
+
+    Record line: ``fleet_req_per_s`` (the headline — requests completed
+    per wall second at the LARGEST decode count) and ``workers`` (the
+    compact topology stamp, e.g. ``"1p+2d"``, without which the rate is
+    uninterpretable). The per-decode-count curve, the scaling ratio vs
+    the single-decode point, shipment byte totals and the liveness
+    counters are detail-only.
+
+    Each worker's engine is pinned to its OWN device (``rank %
+    n_devices``) — the disaggregation analogue: a fleet exists because
+    every worker owns an accelerator, and two engines sharing one
+    device would serialize in the XLA execution stream by
+    construction. The scaling claim is only measurable where that
+    pinning buys real parallel silicon: on the CPU simulator the
+    decode workers' ticks still serialize on the host (one GIL for
+    every dispatch, one shared XLA host threadpool for every fake
+    device), so ``req_per_s_scaling`` honestly reads ~1.0 there — a
+    measured fact about this host, platform-labeled via the record's
+    top-level ``platform``, never extrapolated into a fabricated
+    multi-chip figure (roofline honesty rule). Wall time includes each
+    worker's engine build; the compiles are paid ONCE up front
+    (``warm_engine`` per device + the persistent compile cache) so
+    every point replays them identically and the curve compares fleet
+    topology, not the compiler.
+    """
+    import numpy as np
+
+    from mpit_tpu.models import GPT2, GPT2Config
+    from mpit_tpu.serve import Engine, Request, run_fleet, warm_engine
+
+    cfg = GPT2Config.tiny(
+        vocab_size=512, max_seq_len=max_len, num_layers=4, num_heads=4,
+        d_model=256,
+    )
+    params = jax.jit(GPT2(cfg).init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    devices = jax.devices()
+
+    def factory(role, rank):
+        dev = devices[max(rank, 0) % len(devices)]
+        with jax.default_device(dev):
+            return Engine(
+                cfg, jax.device_put(params, dev), slots=slots,
+                max_len=max_len, prefill_len=prompt_len,
+            )
+
+    rng = np.random.RandomState(0)
+    reqs = [
+        Request(
+            rid=f"f{i}",
+            prompt=[int(t) for t in rng.randint(1, cfg.vocab_size,
+                                                size=prompt_len)],
+            max_new_tokens=max_new,
+        )
+        for i in range(requests)
+    ]
+
+    # Pay every device's compiles ONCE before any timed point: engines
+    # are per-rank and each rank pins its own device, so warm the
+    # LARGEST topology's worth of workers (prefill rank 1, decode
+    # ranks 2..1+max). Timed points then replay cached executables and
+    # the curve compares fleet topology, not the compiler.
+    for rank in range(1, 2 + max(decode_counts)):
+        warm_engine(factory("warmup", rank))
+
+    curve = {}
+    ship_bytes = evictions = 0
+    for d in decode_counts:
+        t0 = time.perf_counter()
+        res = run_fleet(factory, reqs, prefill=1, decode=d)
+        wall = time.perf_counter() - t0
+        done = len(res["completed"])
+        if done != requests:
+            raise RuntimeError(
+                f"fleet bench point decode={d} completed {done}/{requests}"
+            )
+        curve[str(d)] = {
+            "req_per_s": round(done / wall, 2),
+            "wall_s": round(wall, 2),
+        }
+        ship_bytes = sum(
+            w.get("ship_bytes", 0) for w in res["workers"]
+            if w["role"] == "prefill"
+        )
+        evictions = res["router"]["evictions"]
+    d_top = str(max(decode_counts))
+    d_one = str(min(decode_counts))
+    return {
+        "fleet_req_per_s": curve[d_top]["req_per_s"],
+        "workers": f"1p+{d_top}d",
+        "req_per_s_scaling": round(
+            curve[d_top]["req_per_s"] / curve[d_one]["req_per_s"], 3
+        ),
+        "by_decode_workers": curve,
+        "requests": requests,
+        "generated_tokens": requests * max_new,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "ship_bytes": ship_bytes,
+        "evictions": evictions,
+    }
+
+
 def _phase_breakdown(s: dict) -> dict:
     """Per-workload obs roll-up for BENCH_DETAIL.json (never the record
     line — ``_LINE_KEYS`` whitelists what rides there): where the
@@ -3038,10 +3154,22 @@ _LINE_KEYS = {
         "images_per_sec", "mfu_pct",
         "error",
     ),
+    # fleet_req_per_s + workers (ISSUE 19): the disaggregated fleet's
+    # throughput headline and the topology stamp that makes it
+    # readable. Paid for by demoting gpt2's train-side "attention"
+    # label (static engine config — the flash-vs-reference resolution
+    # is pinned per-platform by tier-1's fallback tests, the same
+    # argument that moved decode_attention off the serve line for
+    # ISSUE 17; verbatim in BENCH_DETAIL.json) and gpt2_serve's
+    # max_concurrent_at_hbm (the MODELED fixed-budget concurrency
+    # experiment — ISSUE 18's measured hbm_held_peak_bytes +
+    # kv_headroom_min_pct are the line's capacity verdict now; the
+    # experiment stays verbatim in the paged_capacity detail block
+    # where its A/B context lives).
     "gpt2": (
         "tokens_per_sec",
         "app_path_overhead_pct", "mfu_pct",
-        "attention", "error",
+        "error",
     ),
     "gpt2_moe": (
         "tokens_per_sec", "mfu_pct",
@@ -3102,7 +3230,6 @@ _LINE_KEYS = {
     "gpt2_serve": (
         "decode_tokens_per_sec",
         "accepted_tokens_per_tick",
-        "max_concurrent_at_hbm",
         "hbm_held_peak_bytes", "kv_headroom_min_pct",
         "trace_overhead_pct", "error",
     ),
@@ -3140,6 +3267,11 @@ _LINE_KEYS = {
         "easgd_acc_delta_vs_sync", "straggler_healthy_throughput_pct",
         "rejoin_steps_to_recover", "error",
     ),
+    # ISSUE 19: the fleet headline + topology stamp only (budget
+    # payment itemized above the gpt2 entry); the per-decode-count
+    # curve, scaling ratio, shipment bytes and liveness counters are
+    # detail-file-only.
+    "gpt2_fleet": ("fleet_req_per_s", "workers", "error"),
 }
 
 
@@ -3269,6 +3401,7 @@ def main():
         ("gpt2_slo", bench_gpt2_slo),
         ("gpt2_policy", bench_gpt2_policy),
         ("mnist_easgd", bench_mnist_easgd),
+        ("gpt2_fleet", bench_gpt2_fleet),
     ]
 
     def _watchdog():

@@ -45,6 +45,14 @@ Thirteen PRs of informal discipline, encoded (ISSUE 14 tentpole):
   suppression stating where the bytes ARE accounted: one silent seam
   and the conservation invariant (grants − frees == held) breaks for
   every capacity verdict downstream (ISSUE 18).
+- ``shipment-seam`` — every KV-page serialize/deserialize site named
+  in ``DEFAULT_CONFIG.shipment_seams`` (the fleet's pack/unpack/send/
+  recv/inject functions) must emit a ledger event (a call through an
+  attr chain containing "ledger") or carry an
+  ``# analysis: allow(shipment-seam)`` suppression stating where the
+  shipment IS ledgered: KV bytes crossing the wire unledgered are
+  invisible to fleet why-slow forensics and the P2P attribution
+  (ISSUE 19).
 
 Device-value tracking for ``host-sync-in-hot-seam`` is a local taint
 pass: seeds are calls into ``jnp.*`` / ``jax.*``, jitted handles
@@ -103,6 +111,11 @@ R_MEMLEDGER_SEAM = register_rule(
     "allocation/free seam emits no memory-ledger event — one silent "
     "seam breaks byte conservation for every capacity verdict",
 )
+R_SHIPMENT_SEAM = register_rule(
+    "shipment-seam",
+    "KV-page serialize/deserialize site emits no ledger event — "
+    "shipped bytes go dark in fleet forensics and P2P attribution",
+)
 
 
 @dataclasses.dataclass
@@ -129,6 +142,10 @@ class LintConfig:
     # emit a memory-ledger event (attr chain containing "memledger")
     # or carry # analysis: allow(memledger-seam)
     memledger_seams: dict = dataclasses.field(default_factory=dict)
+    # path suffix -> qualnames of KV-shipment serialize/deserialize
+    # seams: each must emit a ledger event (attr chain containing
+    # "ledger") or carry # analysis: allow(shipment-seam)
+    shipment_seams: dict = dataclasses.field(default_factory=dict)
 
 
 DEFAULT_CONFIG = LintConfig(
@@ -183,6 +200,17 @@ DEFAULT_CONFIG = LintConfig(
         },
         "mpit_tpu/serve/weights.py": {"register_param_store"},
         "mpit_tpu/serve/spec.py": {"register_draft_store"},
+    },
+    # KV-shipment serialize/deserialize seams (ISSUE 19): every site
+    # where KV pages cross the wire must show up in a ledger.
+    shipment_seams={
+        "mpit_tpu/serve/shipment.py": {
+            "pack_shipment",
+            "unpack_shipment",
+            "send_shipment",
+            "recv_shipment",
+            "inject_shipment",
+        },
     },
 )
 
@@ -637,6 +665,29 @@ def _lint_memledger_seam(sf: SourceFile, qualname: str, fn, out) -> None:
         out.append(v)
 
 
+def _lint_shipment_seam(sf: SourceFile, qualname: str, fn, out) -> None:
+    """A configured KV serialize/deserialize seam must emit at least
+    one ledger event — any call whose attribute chain passes through a
+    name containing "ledger" (``ledger.event(...)``,
+    ``self._ledger.event(...)``) counts; guard sites (``if ledger is
+    not None:``) keep the seam wired even when no ledger rides the
+    call."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if any("ledger" in part for part in chain):
+                return
+    v = sf.violation(
+        R_SHIPMENT_SEAM, fn,
+        f"shipment seam {qualname} emits no ledger event — KV bytes "
+        "crossing the wire here are invisible to fleet why-slow "
+        "forensics and P2P attribution; emit one or suppress with "
+        "# analysis: allow(shipment-seam)",
+    )
+    if v:
+        out.append(v)
+
+
 def lint_file(
     sf: SourceFile, cfg: LintConfig = DEFAULT_CONFIG,
     rules: set | None = None,
@@ -682,6 +733,16 @@ def lint_file(
             marked = sf.func_role("memledger-seam", fn.lineno)
             if qualname in memledger_quals or marked:
                 _lint_memledger_seam(sf, qualname, fn, out)
+
+    if on(R_SHIPMENT_SEAM):
+        shipment_quals = set()
+        for suffix, quals in cfg.shipment_seams.items():
+            if _module_matches(sf.path, [suffix]):
+                shipment_quals |= set(quals)
+        for qualname, fn in qualname_visit(sf.tree):
+            marked = sf.func_role("shipment-seam", fn.lineno)
+            if qualname in shipment_quals or marked:
+                _lint_shipment_seam(sf, qualname, fn, out)
 
     if on(R_DETERMINISM) and (
         _module_matches(sf.path, cfg.determinism_modules)

@@ -57,12 +57,26 @@ per-slot KV cache and the request loop is continuous batching.
   directly (leaf contract pinned in ``tests/test_convert.py``);
   ``draft_from_target`` cuts an early-exit self-speculation draft from
   the target's own first N blocks.
+- :mod:`~mpit_tpu.serve.fleet` / :mod:`~mpit_tpu.serve.shipment` —
+  the disaggregated serving fleet (ISSUE 19): a router admits and
+  routes requests with the policy tier's projected-TTFT math, prefill
+  workers run chunked prefill and ship finished KV pages (int8
+  payloads + scale blocks included) to decode workers as
+  length-prefixed shipments on a dedicated ``Comm_dup("fleet-kv")``
+  channel, and liveness rides the EASGD anchor machinery — heartbeat
+  threads, a router-side lease sweep, dead-worker re-queue — with
+  greedy outputs bit-matching the single-engine run per request.
 
 CLI: ``python -m mpit_tpu.serve`` — load a dense checkpoint (or
 random-init), serve a synthetic request stream, print the obs summary.
 """
 
 from mpit_tpu.serve.engine import Engine, sample_tokens
+from mpit_tpu.serve.fleet import (
+    FleetConfig,
+    parse_fleet_spec,
+    run_fleet,
+)
 from mpit_tpu.serve.kvcache import (
     KVCache,
     PageAllocator,
@@ -81,6 +95,7 @@ from mpit_tpu.serve.loadgen import (
     RequestClass,
     generate_arrivals,
     parse_load_spec,
+    split_arrivals,
 )
 from mpit_tpu.serve.policy import (
     PolicyConfig,
@@ -89,6 +104,14 @@ from mpit_tpu.serve.policy import (
     parse_policy_spec,
 )
 from mpit_tpu.serve.scheduler import Completed, Request, Server, warm_engine
+from mpit_tpu.serve.shipment import (
+    KVShipment,
+    inject_shipment,
+    pack_shipment,
+    recv_shipment,
+    send_shipment,
+    unpack_shipment,
+)
 from mpit_tpu.serve.weights import (
     draft_from_target,
     expected_param_shapes,
@@ -103,7 +126,9 @@ __all__ = [
     "Arrival",
     "Completed",
     "Engine",
+    "FleetConfig",
     "KVCache",
+    "KVShipment",
     "LoadSpec",
     "PageAllocator",
     "PagedKVCache",
@@ -114,7 +139,9 @@ __all__ = [
     "SchedulingPolicy",
     "Server",
     "TTFTProjector",
+    "parse_fleet_spec",
     "parse_policy_spec",
+    "run_fleet",
     "alloc_cache",
     "alloc_paged_cache",
     "cache_specs",
@@ -129,7 +156,13 @@ __all__ = [
     "params_wire_bytes",
     "quantize_gpt2_params",
     "weight_wire_bytes",
+    "inject_shipment",
+    "pack_shipment",
     "parse_load_spec",
+    "recv_shipment",
     "sample_tokens",
+    "send_shipment",
+    "split_arrivals",
+    "unpack_shipment",
     "warm_engine",
 ]

@@ -173,6 +173,14 @@ class ServeConfig:
     # serve.policy spec: "quantum=4,preempt=1,admission_factor=1.2,
     # weight.<tenant>=2". Pair with --loadgen priority=/ttft_target=.
     policy: str = ""
+    # Disaggregated fleet (ISSUE 19). "" = single-process server;
+    # otherwise a serve.fleet spec ("prefill=2,decode=2[,lease_s=0.5,
+    # heartbeat_s=0.05,admission_ttft_s=0.3]"): a router + prefill
+    # workers shipping KV pages to decode workers over the compat
+    # layer, driven by the closed-loop synthetic stream. Worker count
+    # excludes the router; every worker builds its own engine from
+    # THIS config's geometry flags.
+    fleet: str = ""
 
     def mesh_shape(self) -> dict[str, int] | None:
         from mpit_tpu.asyncsgd.config import parse_mesh
@@ -437,8 +445,70 @@ def _live_line(registry, monitor, server, now: float) -> str:
     return line
 
 
+def _run_fleet_cli(cfg: ServeConfig) -> dict:
+    """``--fleet prefill=P,decode=D``: the disaggregated serving fleet
+    over the closed-loop synthetic stream. One JSON result: completion
+    counts, per-worker roll-ups, fleet req/s, and the flight block's
+    P2P matrix (KV shipment bytes visible per (src, dst))."""
+    from mpit_tpu.serve.fleet import parse_fleet_spec, run_fleet
+
+    fcfg = parse_fleet_spec(cfg.fleet)
+    engine0, mcfg = _build_engine(cfg)
+    seed_engines = [engine0]
+
+    def factory(role, rank):
+        # Same config + same seed → identical params on every worker
+        # (the bit-match precondition); the probe engine built for the
+        # vocab lookup serves the first worker instead of leaking.
+        if seed_engines:
+            return seed_engines.pop()
+        engine, _ = _build_engine(cfg)
+        return engine
+
+    requests = list(synthetic_requests(cfg, mcfg.vocab_size))
+    t0 = time.perf_counter()
+    out = run_fleet(
+        factory,
+        requests,
+        prefill=fcfg.prefill,
+        decode=fcfg.decode,
+        heartbeat_s=fcfg.heartbeat_s,
+        lease_s=fcfg.lease_s,
+        admission_ttft_s=fcfg.admission_ttft_s,
+        job_timeout_s=fcfg.job_timeout_s,
+    )
+    wall = time.perf_counter() - t0
+    completed = out["completed"]
+    result = {
+        "model": {
+            "layers": mcfg.num_layers,
+            "d_model": mcfg.d_model,
+            "vocab": mcfg.vocab_size,
+            "source": cfg.ckpt or f"random-init {cfg.model}",
+        },
+        "fleet": {"prefill": fcfg.prefill, "decode": fcfg.decode},
+        "wall_s": round(wall, 4),
+        "requests_completed": len(completed),
+        "requests_shed": len(out["shed"]),
+        "fleet_req_per_s": round(len(completed) / wall, 2) if wall else None,
+        "generated_tokens": sum(len(t) for t in completed.values()),
+        "router": {
+            k: v
+            for k, v in out["router"].items()
+            if k not in ("completed", "role")
+        },
+        "workers": out["workers"],
+    }
+    flight = out.get("flight")
+    if flight is not None:
+        result["p2p_bytes"] = np.asarray(flight["p2p_bytes"]).tolist()
+    return result
+
+
 def main(argv: list[str] | None = None) -> dict:
     cfg = from_argv(ServeConfig, argv, prog="python -m mpit_tpu.serve")
+    if cfg.fleet:
+        return _run_fleet_cli(cfg)
     from mpit_tpu import obs
     from mpit_tpu.obs.slo import SLOMonitor
     from mpit_tpu.obs.stream import StreamRegistry

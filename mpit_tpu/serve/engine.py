@@ -107,6 +107,7 @@ from mpit_tpu.serve.kvcache import (
     KVCache,
     PageAllocator,
     PagedKVCache,
+    QuantizedKV,
     alloc_cache,
     alloc_paged_cache,
     cache_specs,
@@ -1769,3 +1770,86 @@ class Engine:
         # Owner recency and exhaustion forensics describe the LAST run;
         # static buffer grants persist (the buffers do too).
         self.memledger.reset_transients()
+
+    def export_kv_rows(self, slot: int, length: int):
+        """Host copy of ``slot``'s first ``length`` cached KV rows in
+        the canonical dense row layout ``[L, length, H, Dh]`` (scale
+        leaves ``[L, length, H, 1]`` on a quantized cache — jax.tree.map
+        descends the QuantizedKV pair). Dense and paged engines yield
+        identical arrays for identical fills — a paged export gathers
+        the slot's block-table pages and trims the tail pad — so a
+        fleet shipment packed from either injects into either. Returns
+        ``(k_rows, v_rows)``."""
+        if length <= 0:
+            raise ValueError(f"export_kv_rows needs length > 0, got {length}")
+        if self.paged:
+            ps = self.page_size
+            npages = -(-length // ps)
+            pages = np.asarray(
+                self.allocator.block_tables[slot, :npages], np.int32
+            )
+
+            def rows(buf):
+                arr = np.asarray(buf[:, pages])  # [L, npages, ps, H, last]
+                nl, n, p, h, last = arr.shape
+                return arr.reshape(nl, n * p, h, last)[:, :length].copy()
+
+        else:
+
+            def rows(buf):
+                return np.asarray(buf[:, slot, :length])
+
+        return (
+            jax.tree.map(rows, self.cache.k),
+            jax.tree.map(rows, self.cache.v),
+        )
+
+    def inject_kv_rows(
+        self, slot: int, k_rows, v_rows, length: int, first_token: int
+    ) -> None:
+        """Inverse of :meth:`export_kv_rows`: install ``length`` rows of
+        shipped KV state into ``slot`` and arm it for decode —
+        ``lengths[slot] = length``, ``last_token[slot] = first_token``
+        (the token the shipping side sampled at prefill end). On a
+        paged engine the caller has already run ``allocator.admit`` for
+        the slot (all-or-nothing, no ``register_prefix`` — injected
+        pages are private, never prefix-shared); rows scatter into the
+        slot's mapped pages. ``k_rows``/``v_rows`` match the export
+        layout — raw arrays, or objects with ``.q``/``.scale`` for a
+        quantized cache (any container with those attributes works;
+        leaves are rebuilt positionally)."""
+        quantized = hasattr(self.cache.k, "q")
+        if quantized:
+            # Rebuild as the cache's own pytree type so tree.map pairs
+            # leaves positionally whatever container shipped them.
+            k_rows = QuantizedKV(q=k_rows.q, scale=k_rows.scale)
+            v_rows = QuantizedKV(q=v_rows.q, scale=v_rows.scale)
+        if self.paged:
+            ps = self.page_size
+            npages = -(-length // ps)
+            pages = np.asarray(
+                self.allocator.block_tables[slot, :npages], np.int32
+            )
+
+            def put(buf, rows):
+                rows = jnp.asarray(np.asarray(rows), buf.dtype)
+                for i in range(npages):
+                    n = min(ps, length - i * ps)
+                    buf = buf.at[:, int(pages[i]), :n].set(
+                        rows[:, i * ps : i * ps + n]
+                    )
+                return buf
+
+        else:
+
+            def put(buf, rows):
+                return buf.at[:, slot, :length].set(
+                    jnp.asarray(np.asarray(rows), buf.dtype)
+                )
+
+        self.cache = type(self.cache)(
+            k=jax.tree.map(put, self.cache.k, k_rows),
+            v=jax.tree.map(put, self.cache.v, v_rows),
+            lengths=self.cache.lengths.at[slot].set(int(length)),
+        )
+        self.last_token = self.last_token.at[slot].set(int(first_token))

@@ -45,6 +45,7 @@ __all__ = [
     "RequestClass",
     "generate_arrivals",
     "parse_load_spec",
+    "split_arrivals",
 ]
 
 
@@ -275,6 +276,33 @@ def generate_arrivals(
                 ),
             )
         )
+    return out
+
+
+def split_arrivals(arrivals, shards: int, *, seed: int = 0) -> list:
+    """Deal one materialized trace across ``shards`` admission streams
+    (the fleet router's per-shard intake — ISSUE 19 determinism fix).
+
+    The shard draw uses its OWN ``RandomState(seed)``, never the trace
+    generator's stream: a split must not perturb the pinned per-class
+    rng streams, so ``generate_arrivals(spec, seed=s)`` stays
+    byte-identical whether or not the trace is subsequently split (the
+    determinism pin in ``tests/test_fleet.py``). Each shard preserves
+    the trace's arrival-time order; the same ``(arrivals, shards,
+    seed)`` always deals identically.
+    """
+    if shards < 1:
+        raise ValueError(f"shards must be >= 1, got {shards}")
+    arrivals = list(arrivals)
+    if shards == 1:
+        return [arrivals]
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    assign = rng.randint(0, shards, size=len(arrivals))
+    out: list[list] = [[] for _ in range(shards)]
+    for arrival, shard in zip(arrivals, assign):
+        out[int(shard)].append(arrival)
     return out
 
 

@@ -273,10 +273,17 @@ class Server:
     """
 
     def __init__(self, engine, *, sentinel=None, stream=None, slo=None,
-                 max_queue=None, policy=None, ledger=None):
+                 max_queue=None, policy=None, ledger=None,
+                 worker_id="", role=""):
         self.engine = engine
         self.sentinel = sentinel
         self.policy = policy
+        # Fleet identity (ISSUE 19): a stable stamp on stats() and the
+        # memory verdict so fleet-merged stats attribute bytes/tokens
+        # per worker, not per process-anonymous engine. Standalone
+        # servers report the explicit singleton identity.
+        self.worker_id = worker_id or "single"
+        self.role = role or "standalone"
         # Request lifecycle ledger (ISSUE 16): per-request causal events
         # at every decision seam, tail-exemplar retention, why-slow
         # attribution. ``None`` skips even the guard-site calls — the
@@ -1692,6 +1699,8 @@ class Server:
             return {}
         out = {
             "source": "memledger",
+            "worker_id": self.worker_id,
+            "role": self.role,
             "platform": ml.platform,
             "held_bytes": int(ml.held()),
             "held_peak_bytes": int(max(self._held_peak, int(ml.held()))),
@@ -1759,6 +1768,8 @@ class Server:
         span-derived histograms; this is the request-math view)."""
         done = self.completed
         out = {
+            "worker_id": self.worker_id,
+            "role": self.role,
             "requests_completed": len(done),
             "ticks": self.tick,
             "admissions": self.admissions,

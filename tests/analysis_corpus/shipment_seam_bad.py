@@ -1,0 +1,13 @@
+"""Corpus: shipment-seam fires exactly once — a marked KV
+serialize/deserialize site that moves page bytes across the wire
+without emitting a ledger event goes dark in fleet why-slow forensics
+and P2P attribution."""
+
+
+# analysis: shipment-seam
+def pack_pages(ship, comm):  # VIOLATION
+    frames = [leaf.tobytes() for _, leaf in ship.leaves()]
+    payload = b"".join(frames)
+    comm.send(len(payload), ship.dest)
+    comm.send(payload, ship.dest)
+    return len(payload)

@@ -1,0 +1,26 @@
+"""Corpus false-positive guards for shipment-seam: a marked
+serialize site that emits through the guarded ledger idiom, a marked
+site whose suppression names where the shipment IS ledgered, and an
+unmarked helper that never touches the wire."""
+
+
+# analysis: shipment-seam
+def pack_pages(ship, comm, ledger=None):
+    frames = [leaf.tobytes() for _, leaf in ship.leaves()]
+    payload = b"".join(frames)
+    comm.send(len(payload), ship.dest)
+    comm.send(payload, ship.dest)
+    if ledger is not None:  # guarded emit: fine
+        ledger.event(ship.rid, "kv_ship_pack", bytes=len(payload))
+    return len(payload)
+
+
+# The recv side ledgers the same bytes on arrival (kv_ship_recv).
+# analysis: shipment-seam
+def forward_raw(payload, dest, comm):  # analysis: allow(shipment-seam)
+    comm.send(len(payload), dest)
+    comm.send(payload, dest)
+
+
+def shipment_bytes(ship):  # unmarked helper, no wire crossing: fine
+    return sum(leaf.nbytes for _, leaf in ship.leaves())

@@ -58,6 +58,11 @@ class TestCorpusRulesFire:
             ("thread_bind_bad.py", "thread_bind_ok.py", "thread-bind"),
             ("ledger_seam_bad.py", "ledger_seam_ok.py", "ledger-seam"),
             ("memledger_bad.py", "memledger_ok.py", "memledger-seam"),
+            (
+                "shipment_seam_bad.py",
+                "shipment_seam_ok.py",
+                "shipment-seam",
+            ),
             ("kernel_dma_bad.py", "kernel_dma_ok.py", "kernel-dma-balance"),
             ("kernel_ring_bad.py", None, "kernel-ring-order"),
         ],
@@ -83,6 +88,7 @@ class TestCorpusRulesFire:
             ("thread_bind_bad.py", "thread-bind"),
             ("ledger_seam_bad.py", "ledger-seam"),
             ("memledger_bad.py", "memledger-seam"),
+            ("shipment_seam_bad.py", "shipment-seam"),
             ("kernel_ring_bad.py", "kernel-ring-order"),
         ]:
             _, violations = run_static([corpus(name)], rules={rule})
@@ -96,7 +102,7 @@ class TestCorpusRulesFire:
 
     def test_whole_corpus_exactly_one_violation_per_rule(self):
         """The corpus README pin: analyzing the whole corpus directory
-        yields exactly the nine seeded violations — one per static
+        yields exactly the ten seeded violations — one per static
         rule, nothing from the ok twins."""
         code, violations = run_static([CORPUS])
         assert code == 1
@@ -106,7 +112,8 @@ class TestCorpusRulesFire:
                 "host-sync-in-hot-seam", "jit-in-hot-seam",
                 "determinism-seam", "unlabeled-utilization",
                 "thread-bind", "ledger-seam", "memledger-seam",
-                "kernel-dma-balance", "kernel-ring-order",
+                "shipment-seam", "kernel-dma-balance",
+                "kernel-ring-order",
             ]
         ), [v.format() for v in violations]
         assert all("_bad.py" in v.path for v in violations)

@@ -617,6 +617,26 @@ def _realistic_results():
             "phases": phases,
             "obs_baseline": obs_baseline,
         },
+        # ISSUE 19: the disaggregated fleet's headline rate + topology
+        # stamp ride the line; the per-decode-count curve, scaling
+        # ratio, shipment bytes and liveness counters are detail-only.
+        "gpt2_fleet": {
+            "fleet_req_per_s": 1234.56,
+            "workers": "1p+2d",
+            "req_per_s_scaling": 1.876,
+            "by_decode_workers": {
+                "1": {"req_per_s": 658.12, "wall_s": 12.34},
+                "2": {"req_per_s": 1234.56, "wall_s": 11.22},
+            },
+            "requests": 12,
+            "generated_tokens": 288,
+            "prompt_len": 16,
+            "max_new_tokens": 24,
+            "ship_bytes": 1234567,
+            "evictions": 0,
+            "phases": phases,
+            "obs_baseline": obs_baseline,
+        },
         "allreduce": {
             "gbps": 50.88,
             # ISSUE 9: the ring + quantized-ring figures join the line
@@ -741,14 +761,13 @@ class TestLineBudget:
         # floor, per-context acceptance, tokens/s both ways, TTFT
         # deltas) is detail-file-only.
         assert serve["accepted_tokens_per_tick"] == 3.6123
-        # ISSUE 7: max concurrency at the fixed HBM budget keeps the
-        # capacity verdict on the line; the full capacity-sweep and
-        # chunked-prefill A/B blocks are detail-only (kv_page_size,
-        # static geometry, moved detail-only to pay for ISSUE 12's
-        # gpt2_policy triple; prefix_hit_rate — the mechanism behind
-        # the concurrency number — moved detail-only to pay for
-        # ISSUE 16's ledger pair).
-        assert serve["max_concurrent_at_hbm"] == 128
+        # ISSUE 7's fixed-budget concurrency experiment moved
+        # detail-only for ISSUE 19 (fleet budget payment): ISSUE 18's
+        # measured held peak + headroom floor are the line's capacity
+        # verdict; the experiment stays verbatim in paged_capacity
+        # (kv_page_size and prefix_hit_rate went detail-only earlier —
+        # ISSUE 12 / ISSUE 16 payments).
+        assert "max_concurrent_at_hbm" not in serve
         # ISSUE 18: the memory ledger's MEASURED held-bytes peak and
         # the KV headroom floor ride the line — the byte-exact capacity
         # verdict; the full ledger block is detail-only. Paid for by
@@ -831,6 +850,19 @@ class TestLineBudget:
                          "steps_per_replica", "sync_accuracy",
                          "elastic_accuracy", "anchor_version"):
             assert off_line not in easgd
+        # ISSUE 19: the fleet's headline rate + topology stamp ride the
+        # line; curve/scaling/shipment/liveness detail stays off it.
+        # Paid for by gpt2's static train "attention" label moving
+        # detail-only (pinned per-platform by tier-1's fallback tests,
+        # like decode_attention before it).
+        fleet = rec["detail"]["gpt2_fleet"]
+        assert fleet["fleet_req_per_s"] == 1234.56
+        assert fleet["workers"] == "1p+2d"
+        for off_line in ("req_per_s_scaling", "by_decode_workers",
+                         "requests", "generated_tokens", "prompt_len",
+                         "max_new_tokens", "ship_bytes", "evictions"):
+            assert off_line not in fleet
+        assert "attention" not in rec["detail"]["gpt2"]
         # ISSUE 8: every train workload's mfu_pct rides the line; the
         # full measured-vs-modeled roofline block is detail-only.
         assert rec["detail"]["alexnet"]["mfu_pct"] == 52.34
@@ -880,7 +912,7 @@ class TestLineBudget:
         # Worst case: every workload died before producing numbers.
         rec = json.loads(_line({}, truncated=[
             "allreduce", "alexnet", "gpt2", "resnet50", "gpt2_moe",
-            "gpt2_serve", "gpt2_slo", "mnist_easgd",
+            "gpt2_serve", "gpt2_slo", "mnist_easgd", "gpt2_fleet",
         ], elapsed_s=0.5))
         assert rec["value"] is None
         assert rec["vs_baseline"] is None
