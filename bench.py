@@ -2495,8 +2495,10 @@ def bench_gpt2_policy(
         s.run()
         capacity = n_cal / (time.perf_counter() - t0)
 
-    def _run_point(arrivals, by_rid, use_policy, ledger=None):
-        engine.reset()
+    def _run_point(arrivals, by_rid, use_policy, ledger=None, eng=None,
+                   drain=False):
+        eng = engine if eng is None else eng
+        eng.reset()
         registry = StreamRegistry(window_s=window_s)
         sentinel = obs.Sentinel(phases=("decode", "prefill"), warmup=4)
         # The SLO watches the INTERACTIVE tier's TTFT series (fed for
@@ -2514,11 +2516,11 @@ def bench_gpt2_policy(
             else None
         )
         server = Server(
-            engine, sentinel=sentinel, stream=registry, slo=monitor,
+            eng, sentinel=sentinel, stream=registry, slo=monitor,
             policy=policy, ledger=ledger,
         )
         t0 = time.perf_counter()
-        server.run_timed(arrivals, duration=duration_s, drain=False)
+        server.run_timed(arrivals, duration=duration_s, drain=drain)
         wall = time.perf_counter() - t0
         stats = server.stats()
         done = server.completed
@@ -2568,6 +2570,31 @@ def bench_gpt2_policy(
             )
             entry["shed_queue_full"] = stats.get(
                 "requests_shed_queue_full", 0
+            )
+        # ISSUE 20 tiering A/B evidence: the resume-path p95s (present
+        # once the mode's resumes have fired — restream on the tiered
+        # engine, recompute on the untiered one), the prefix hit rate
+        # the host tier is supposed to hold up, and — tiered runs only —
+        # the host-tier counters/byte totals.
+        for k in ("resume_restream_p95_s", "resume_recompute_p95_s",
+                  "prefix_hit_rate"):
+            if k in stats:
+                entry[k] = stats[k]
+        if "host_restreamed_pages" in stats:
+            entry["host"] = {
+                k: stats[k]
+                for k in ("kv_host_pages", "host_spilled_pages",
+                          "host_restreamed_pages", "host_prefix_hits",
+                          "parked_spills", "spilled_prefix_entries")
+            }
+            entry["host"]["spill_bytes_total"] = (
+                stats["memory"]["spill_bytes_total"]
+            )
+            entry["host"]["restream_bytes"] = (
+                stats["memory"]["restream_bytes"]
+            )
+            entry["host"]["host_held_peak_bytes"] = (
+                stats["memory"]["host_held_peak_bytes"]
             )
         return entry
 
@@ -2619,6 +2646,53 @@ def bench_gpt2_policy(
                 preemptions_total += entry["preemptions"]
         sweep.append(point)
 
+    # ISSUE 20 — the HBM→host tiering A/B: the SAME policy engine
+    # geometry at the SAME saturated rate (the ladder's top fraction),
+    # but on a LONG-TAIL trace — every request opens with a shared
+    # 16-token system prefix, so the undersized pool reclaims the
+    # prefix pages over and over. Untiered, the reclaim kills the
+    # entry and every later admit recomputes (and every preemption
+    # resume recomputes its fill); tiered, the entry and parked
+    # victims spill to host RAM and restream. Both arms DRAIN so every
+    # parked victim actually resumes and the p95s compare the same
+    # completed population. CPU honesty: this host's "host tier" is a
+    # same-RAM copy through the jitted gather/scatter, so the measured
+    # restream p95 is an honest wall-clock for THIS platform but NOT a
+    # PCIe/DMA measurement — the modeled per-page figure next to it is
+    # the labeled transfer estimate.
+    tail_mix = (
+        _dc.replace(interactive, prefix_len=16),
+        _dc.replace(batch, prompt_len=(4, 14), prefix_len=16),
+    )
+    # Bursty, not Poisson: the steady saturated stream always has a
+    # CONCURRENT reader on the shared prefix, so its entry never goes
+    # sole-reader and both arms hit alike. Bursts at 4× the mean rate
+    # bring the preemption pressure (parks → restream resumes);
+    # the silent off-phases drain the pool, the prefix goes
+    # sole-reader, and the reclaim that untiered kills — and the host
+    # tier survives — actually happens, burst after burst.
+    top_rate = rate_fractions[-1] * capacity
+    tail_arrivals = generate_arrivals(
+        LoadSpec(rate=top_rate, classes=tail_mix, tenants=2,
+                 process="bursty", on_fraction=0.25, mean_on_s=0.25),
+        vocab_size=cfg.vocab_size,
+        duration_s=duration_s,
+        seed=777,
+    )
+    tail_by_rid = {a.request.rid: a for a in tail_arrivals}
+    tiered_engine = Engine(
+        cfg, params, slots=slots, max_len=max_len, prefill_len=prefill_len,
+        kv_pages=kv_pages, kv_page_size=kv_page_size,
+        prefill_chunk=prefill_chunk, kv_host_pages=kv_pages,
+    )
+    warm_engine(tiered_engine)
+    tier_ab = {}
+    for tmode, eng_used in (("untiered", engine), ("tiered", tiered_engine)):
+        with obs.span("tiering_point", mode=tmode):
+            tier_ab[tmode] = _run_point(
+                tail_arrivals, tail_by_rid, True, eng=eng_used, drain=True
+            )
+
     def _ms(v):
         return round(v * 1e3, 2) if v is not None else None
 
@@ -2635,8 +2709,44 @@ def bench_gpt2_policy(
         forensics["exemplars"] = forensics["exemplars"][:3]
         forensics["exemplars_stored"] = len(forensics["exemplars"])
 
+    # The line's tiering triple (ISSUE 20): p95 resume-via-restream
+    # (tiered arm) vs p95 resume-via-recompute (untiered arm) on the
+    # same drained long-tail trace, and the prefix hit rate the host
+    # tier held up under pool pressure ("hit_rate" — the untiered
+    # counterpart it must beat sits in tiering_detail). A p95 is null
+    # until its arm's resumes fired — never fabricated.
+    t_ent = tier_ab["tiered"]
+    u_ent = tier_ab["untiered"]
+    page_bytes = tiered_engine.page_bytes
+    host_link_gbps = 16.0  # assumed PCIe gen4-ish effective host link
+    tiering_detail = {
+        "prefix_hit_rate_tiered": t_ent.get("prefix_hit_rate"),
+        "prefix_hit_rate_untiered": u_ent.get("prefix_hit_rate"),
+        "kv_host_pages": kv_pages,
+        "shared_prefix_len": 16,
+        "offered_req_per_s": round(len(tail_arrivals) / duration_s, 2),
+        "untiered": u_ent,
+        "tiered": t_ent,
+        # The labeled transfer model (never passed off as measured):
+        # one page over an assumed host link, plus the same-RAM
+        # platform note that keeps the measured p95 honest.
+        "host_link_gbps_assumed": host_link_gbps,
+        "modeled_page_restream_us": round(
+            (page_bytes / (host_link_gbps * 1e9) + 10e-6) * 1e6, 2
+        ),
+        "note": "CPU host tier is a same-RAM copy; measured restream "
+                "p95 is wall-clock on this host, not a PCIe/DMA "
+                "measurement",
+    }
+
     return {
         "trace_forensics": forensics,
+        "tiering": {
+            "restream_p95_ms": _ms(t_ent.get("resume_restream_p95_s")),
+            "recompute_p95_ms": _ms(u_ent.get("resume_recompute_p95_s")),
+            "hit_rate": t_ent.get("prefix_hit_rate"),
+        },
+        "tiering_detail": tiering_detail,
         "max_sustained_req_per_s_policy": (
             round(max_sustained["policy"], 2)
             if max_sustained["policy"] is not None else None
@@ -3142,7 +3252,7 @@ _LINE_KEYS = {
     # geometry) and gpt2_slo's ttft_target_s (the sweep's calibration
     # context — headline + breach count keep the verdict on the line).
     "alexnet": (
-        "images_per_sec", "app_path_overhead_pct", "mfu_pct",
+        "images_per_sec", "mfu_pct",
         "error",
     ),
     # To pay for ISSUE 9's allreduce pair inside the ≤1.2k budget,
@@ -3244,21 +3354,34 @@ _LINE_KEYS = {
         "max_sustained_req_per_s", "slo_breaches",
         "error",
     ),
-    # ISSUE 12: the policy A/B's headline triple — max sustained req/s
+    # ISSUE 12: the policy A/B's headline pair — max sustained req/s
     # under the POLICY at p95 interactive TTFT ≤ target (the FIFO
-    # counterpart it must beat sits in detail), the policy's
-    # interactive-tier p95 at the top swept rate, and the preemption
-    # count proving the eviction path actually ran. Curve, calibration,
+    # counterpart it must beat sits in detail) and the policy's
+    # interactive-tier p95 at the top swept rate. Curve, calibration,
     # geometry, target and the FIFO numbers are detail-file-only; the
     # budget payment is itemized above the alexnet entry.
+    # tiering (ISSUE 20): the HBM→host A/B's verdict object — p95
+    # resume-via-restream vs resume-via-recompute on the drained
+    # long-tail trace, and the prefix hit rate the host tier held up
+    # under pool pressure ("hit_rate"; the untiered counterpart and
+    # the byte/counter evidence live in tiering_detail). Paid for by
+    # demoting preemptions (a non-null restream_p95_ms REQUIRES the
+    # preempt→park→resume path to have run, so the count's
+    # proof-of-work role is subsumed; verbatim per-point in detail),
+    # alexnet's app_path_overhead_pct (EXACTLY derivable on the line:
+    # 100 × (1 − record.value / alexnet.images_per_sec)) and the
+    # allreduce ring_gbps (off-TPU it is byte-identical to gbps by the
+    # shared ring model; the measured-vs-stock comparison lives in the
+    # by_payload_mb detail curve — q8_gbps, the figure with its own
+    # information, stays).
     "gpt2_policy": (
         "max_sustained_req_per_s_policy", "interactive_ttft_p95_ms",
-        "preemptions", "error",
+        "tiering", "error",
     ),
     # ISSUE 9: the ring and quantized-ring figures ride the line next to
     # the stock one (modeled off-TPU — the `modeled` flag labels all
     # three); the per-payload three-variant curve stays detail-only.
-    "allreduce": ("gbps", "ring_gbps", "q8_gbps", "modeled", "error"),
+    "allreduce": ("gbps", "q8_gbps", "modeled", "error"),
     # ISSUE 11: the elastic tier's robustness triple — accuracy parity
     # with sync SPMD, healthy-replica throughput under an injected
     # straggler, and steps re-trained after a kill+rejoin. Fleet/fault

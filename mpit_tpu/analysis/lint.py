@@ -53,6 +53,15 @@ Thirteen PRs of informal discipline, encoded (ISSUE 14 tentpole):
   shipment IS ledgered: KV bytes crossing the wire unledgered are
   invisible to fleet why-slow forensics and the P2P attribution
   (ISSUE 19).
+- ``tier-seam`` — every device↔host page-copy site named in
+  ``DEFAULT_CONFIG.tier_seams`` (the engine's spill/restore/host-free
+  wrappers) must emit a memory-ledger event (a call through an attr
+  chain containing "memledger" or "ledger") or carry an
+  ``# analysis: allow(tier-seam)`` suppression stating where the
+  transfer IS charged: a page crossing the HBM↔host boundary outside
+  the ledger-charged seam makes the per-tier conservation invariant
+  and the spill/restream byte counters lie to every capacity verdict
+  (ISSUE 20).
 
 Device-value tracking for ``host-sync-in-hot-seam`` is a local taint
 pass: seeds are calls into ``jnp.*`` / ``jax.*``, jitted handles
@@ -116,6 +125,11 @@ R_SHIPMENT_SEAM = register_rule(
     "KV-page serialize/deserialize site emits no ledger event — "
     "shipped bytes go dark in fleet forensics and P2P attribution",
 )
+R_TIER_SEAM = register_rule(
+    "tier-seam",
+    "device<->host page copy outside the ledger-charged spill/restore "
+    "seam — cross-tier bytes go dark and per-tier conservation lies",
+)
 
 
 @dataclasses.dataclass
@@ -146,6 +160,10 @@ class LintConfig:
     # seams: each must emit a ledger event (attr chain containing
     # "ledger") or carry # analysis: allow(shipment-seam)
     shipment_seams: dict = dataclasses.field(default_factory=dict)
+    # path suffix -> qualnames of device<->host page-copy seams: each
+    # must emit a memory-ledger event (attr chain containing
+    # "memledger"/"ledger") or carry # analysis: allow(tier-seam)
+    tier_seams: dict = dataclasses.field(default_factory=dict)
 
 
 DEFAULT_CONFIG = LintConfig(
@@ -210,6 +228,18 @@ DEFAULT_CONFIG = LintConfig(
             "send_shipment",
             "recv_shipment",
             "inject_shipment",
+        },
+    },
+    # Device<->host page-copy seams (ISSUE 20): every spill/restore/
+    # host-free transition must charge the memory ledger at dispatch
+    # or release. (``Engine.drain_spills`` is deliberately absent —
+    # it only materializes payloads whose bytes were charged when
+    # ``spill_page`` dispatched the copy.)
+    tier_seams={
+        "mpit_tpu/serve/engine.py": {
+            "Engine.spill_page",
+            "Engine.restore_page",
+            "Engine.host_free",
         },
     },
 )
@@ -688,6 +718,30 @@ def _lint_shipment_seam(sf: SourceFile, qualname: str, fn, out) -> None:
         out.append(v)
 
 
+def _lint_tier_seam(sf: SourceFile, qualname: str, fn, out) -> None:
+    """A configured device<->host page-copy seam must emit at least one
+    memory-ledger event — any call whose attribute chain passes through
+    a name containing "memledger" or "ledger"
+    (``self.memledger.grant(...)``) counts; guard sites (conditional
+    frees on the release path) keep the seam wired even when the
+    transfer is a no-op at runtime."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if any("ledger" in part for part in chain):
+                return
+    v = sf.violation(
+        R_TIER_SEAM, fn,
+        f"tier seam {qualname} moves pages across the HBM<->host "
+        "boundary without a memory-ledger event — cross-tier bytes go "
+        "dark and per-tier conservation (grants - frees == held) lies "
+        "to every capacity verdict; charge the ledger or suppress with "
+        "# analysis: allow(tier-seam)",
+    )
+    if v:
+        out.append(v)
+
+
 def lint_file(
     sf: SourceFile, cfg: LintConfig = DEFAULT_CONFIG,
     rules: set | None = None,
@@ -743,6 +797,16 @@ def lint_file(
             marked = sf.func_role("shipment-seam", fn.lineno)
             if qualname in shipment_quals or marked:
                 _lint_shipment_seam(sf, qualname, fn, out)
+
+    if on(R_TIER_SEAM):
+        tier_quals = set()
+        for suffix, quals in cfg.tier_seams.items():
+            if _module_matches(sf.path, [suffix]):
+                tier_quals |= set(quals)
+        for qualname, fn in qualname_visit(sf.tree):
+            marked = sf.func_role("tier-seam", fn.lineno)
+            if qualname in tier_quals or marked:
+                _lint_tier_seam(sf, qualname, fn, out)
 
     if on(R_DETERMINISM) and (
         _module_matches(sf.path, cfg.determinism_modules)

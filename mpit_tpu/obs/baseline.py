@@ -89,7 +89,8 @@ def snapshot(
         # diffs as "no memory section", never as a vacuous pass.
         mem = {
             k: memory[k]
-            for k in ("held_peak_bytes", "kv_headroom_min_pct", "platform")
+            for k in ("held_peak_bytes", "kv_headroom_min_pct", "platform",
+                      "host_held_peak_bytes", "restream_bytes")
             if isinstance(memory.get(k), (int, float, str))
         }
         if isinstance(mem.get("held_peak_bytes"), (int, float)):
@@ -253,6 +254,31 @@ def diff(
             "base": round(float(b_head), 2),
             "cur": round(float(c_head), 2),
         }
+    # Host-tier keys (ISSUE 20), same never-gate-vacuously rule: a
+    # pre-tiering baseline carries no host peak, so nothing gates.
+    # Host-peak GROWTH is a spill leak (payloads granted at dispatch
+    # and never released); restream bytes are reported for context.
+    b_hp = bm.get("host_held_peak_bytes")
+    c_hp = cm.get("host_held_peak_bytes")
+    if (
+        isinstance(b_hp, (int, float))
+        and isinstance(c_hp, (int, float))
+        and b_hp > 0
+    ):
+        growth = 100.0 * (c_hp - b_hp) / b_hp
+        entry = {
+            "base": int(b_hp),
+            "cur": int(c_hp),
+            "growth_pct": round(growth, 2),
+            "regressed": bool(growth > tolerance_pct),
+        }
+        mem["host_held_peak_bytes"] = entry
+        if entry["regressed"]:
+            mem_regressions.append("memory.host_held_peak_bytes")
+    b_rs = bm.get("restream_bytes")
+    c_rs = cm.get("restream_bytes")
+    if isinstance(b_rs, (int, float)) and isinstance(c_rs, (int, float)):
+        mem["restream_bytes"] = {"base": int(b_rs), "cur": int(c_rs)}
     out = {
         "tolerance_pct": tolerance_pct,
         "phases": phases,

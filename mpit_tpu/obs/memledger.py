@@ -409,12 +409,17 @@ def capacity_report(doc: dict, *, workload: str | None = None) -> dict:
             if capacity else None
         )
         headroom_min_pct = None
+        host = mem["subsystems"].get("kv_host_pages", {})
+        host_held = host.get("held_bytes") if host else None
+        host_cap = host.get("capacity_bytes") if host else None
     else:
         by_sub = dict(mem.get("held_by_subsystem", {}))
         capacity = mem.get("kv_capacity_bytes")
         headroom = mem.get("kv_headroom_bytes")
         headroom_pct = mem.get("kv_headroom_pct")
         headroom_min_pct = mem.get("kv_headroom_min_pct")
+        host_held = mem.get("host_held_bytes")
+        host_cap = mem.get("host_capacity_bytes")
     conservation = mem.get("conservation", {})
     report = {
         "source": label,
@@ -430,6 +435,16 @@ def capacity_report(doc: dict, *, workload: str | None = None) -> dict:
         "kv_headroom_min_pct": headroom_min_pct,
         "conservation_ok": bool(conservation.get("ok", False)),
     }
+    if host_held is not None:
+        # Host KV tier (ISSUE 20): present only when the run carried a
+        # tiered pool — a pre-tiering snapshot reports no host line.
+        report["host_held_bytes"] = int(host_held)
+        if host_cap is not None:
+            report["host_capacity_bytes"] = int(host_cap)
+        if mem.get("host_held_peak_bytes") is not None:
+            report["host_held_peak_bytes"] = int(
+                mem["host_held_peak_bytes"]
+            )
     if mem.get("reconciliation"):
         report["reconciliation"] = mem["reconciliation"]
     if mem.get("eviction_candidates"):
@@ -470,6 +485,19 @@ def format_capacity(report: dict) -> str:
         if report.get("kv_headroom_min_pct") is not None:
             line += f"   min {report['kv_headroom_min_pct']:.1f}%"
         lines.append(line)
+    if report.get("host_held_bytes") is not None:
+        # The host tier's own line (ISSUE 20) — mirrors the pool line
+        # so "which tier is full" is readable at a glance.
+        line = f"  host tier held {_fmt_bytes(report['host_held_bytes'])}"
+        cap = report.get("host_capacity_bytes")
+        if cap:
+            line += (
+                f" of {_fmt_bytes(cap)} "
+                f"({100.0 * report['host_held_bytes'] / cap:.1f}%)"
+            )
+        if report.get("host_held_peak_bytes") is not None:
+            line += f"   peak {_fmt_bytes(report['host_held_peak_bytes'])}"
+        lines.append(line)
     rec = report.get("reconciliation")
     if rec:
         if rec.get("device_bytes") is not None:
@@ -492,17 +520,26 @@ def format_capacity(report: dict) -> str:
     if ev:
         lines.append(f"  eviction candidates ({len(ev)}, coldest first):")
         for c in ev[:8]:
+            # ``tier`` names where the candidate currently LIVES
+            # (ISSUE 20): reclaiming an hbm candidate buys pool pages,
+            # a host one buys host capacity at the price of a hit.
+            tier = f" tier={c['tier']}" if c.get("tier") else ""
             lines.append(
                 f"    {c.get('kind', '?'):<20} "
                 f"{_fmt_bytes(c.get('bytes', 0)):>12}  "
-                f"last_touch=t{c.get('last_touch_tick', 0)} "
+                f"last_touch=t{c.get('last_touch_tick', 0)}{tier} "
                 f"{c.get('rid', c.get('key', ''))}"
             )
     ex = report.get("exhaustion")
     if ex:
+        pressure = (
+            f" pressure={ex['tier_pressure']}"
+            if ex.get("tier_pressure") else ""
+        )
         lines.append(
             f"  last exhaustion: tick={ex.get('tick')} "
             f"headroom={_fmt_bytes(ex.get('kv_headroom_bytes', 0))}"
+            + pressure
         )
         for h in ex.get("top_holders", [])[:5]:
             lines.append(

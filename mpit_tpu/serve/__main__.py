@@ -121,6 +121,14 @@ class ServeConfig:
     kv_pages: int = 0
     kv_page_size: int = 16
     prefill_chunk: int = 0
+    # Host KV tier (ISSUE 20). kv_host_pages > 0 gives the paged
+    # engine a host-RAM page store: preemption victims park their
+    # pages there (resume restreams instead of re-prefilling) and
+    # dying sole-reader prefix entries migrate there (admission hits
+    # keep working after their HBM pages are reclaimed). Passed
+    # through unconditionally so --kv-host-pages without --kv-pages
+    # surfaces the Engine's "paged-engine knob" rejection.
+    kv_host_pages: int = 0
     # KV cache wire dtype (ISSUE 15). "" = the model dtype (default
     # path, byte-identical); f32|bf16 pin the cache dtype; int8 stores
     # quantized rows + per-(row, head) scales and fuses the dequant
@@ -325,6 +333,7 @@ def _build_engine(cfg: ServeConfig):
         sample_k_cap=max(cfg.sample_k_cap, cfg.top_k),
         kv_pages=cfg.kv_pages or None,
         kv_page_size=cfg.kv_page_size,
+        kv_host_pages=cfg.kv_host_pages or None,
         # Passed through unconditionally: --prefill-chunk without
         # --kv-pages must surface the Engine's "paged-engine knob"
         # rejection, not silently run whole-prompt prefills.

@@ -430,6 +430,7 @@ class Engine:
         sample_k_cap: int = 128,
         kv_pages: int | None = None,
         kv_page_size: int = 16,
+        kv_host_pages: int | None = None,
         prefill_chunk: int | None = None,
         spec_k: int = 0,
         draft_params=None,
@@ -506,6 +507,20 @@ class Engine:
         # HBM reservation. prefill_chunk splits long admits into chunk
         # slices interleaved with decode ticks (scheduler-driven).
         self.paged = kv_pages is not None
+        # ISSUE 20: host-RAM KV tier — host_pages page-sized spill
+        # seats whose payloads live as numpy pytrees on this engine.
+        # 0/None = no tier (every path byte-identical to pre-tiering).
+        self.host_pages = int(kv_host_pages or 0)
+        if self.host_pages < 0:
+            raise ValueError(
+                f"kv_host_pages must be >= 0, got {kv_host_pages}"
+            )
+        if self.host_pages and not self.paged:
+            raise ValueError(
+                "kv_host_pages is the paged engine's host KV tier; the "
+                "dense cache spills whole slots via export_kv_rows "
+                "(pass kv_pages=)"
+            )
         if self.paged:
             if kv_pages < 1:
                 raise ValueError(f"kv_pages must be >= 1, got {kv_pages}")
@@ -832,7 +847,8 @@ class Engine:
             # index, COW reservations, per-slot block tables (the tables
             # ride into every jitted step as a tiny int32 argument).
             self.allocator = PageAllocator(
-                self.num_pages, self.page_size, self.pages_per_slot, slots
+                self.num_pages, self.page_size, self.pages_per_slot, slots,
+                host_pages=self.host_pages,
             )
             self.cache = alloc_paged_cache(
                 cfg, slots, self.num_pages, self.page_size,
@@ -846,6 +862,9 @@ class Engine:
             else:
                 self._decode_paged_jit = jax.jit(self._paged_decode_step)
             self._copy_page_jit = jax.jit(self._copy_page_step)
+            if self.host_pages:
+                self._gather_page_jit = jax.jit(self._gather_page_step)
+                self._scatter_page_jit = jax.jit(self._scatter_page_step)
         else:
             self.allocator = None
             self.cache = alloc_cache(
@@ -886,9 +905,13 @@ class Engine:
         # Speculation keeps the discipline with ONE extra compile: the
         # decode tick splits into spec_draft + spec_verify (the plain
         # decode step is never built).
+        # The host tier adds exactly two more (gather_page +
+        # scatter_page — page ids traced, payload shapes fixed), still
+        # zero per-request recompiles (ISSUE 20).
         self.compile_watch = _roofline.CompileWatch(
             expected=(3 if self.paged else 2)
-            + (1 if self.spec_k else 0),
+            + (1 if self.spec_k else 0)
+            + (2 if self.host_pages else 0),
             scope="engine",
         )
         # Per-execution modeled costs (set by register_roofline).
@@ -963,6 +986,32 @@ class Engine:
             self.memledger.register("kv_cow_reserve", nested_in="kv_pool")
             self.allocator.memledger = self.memledger
             self.allocator.page_bytes = self.page_bytes
+            if self.host_pages:
+                # ISSUE 20: the host-RAM page store. Charged at spill
+                # dispatch, refunded at restream / promotion / cold
+                # eviction / reset — the engine's spill/restore seam is
+                # the ONLY writer (the tier-seam lint pins this).
+                # nested_in="host_ram" keeps host bytes out of held()'s
+                # HBM total while per-tier conservation still holds.
+                self.memledger.register(
+                    "kv_host_pages",
+                    capacity_bytes=self.host_pages * self.page_bytes,
+                    nested_in="host_ram",
+                )
+                # host page id -> numpy pytree of one page's rows (K +
+                # V, every layer, int8 payload + scale blocks together,
+                # draft pool included on a speculative engine).
+                self._host_store: dict[int, Any] = {}
+                # Dispatched-but-undrained spills: (host_page, device
+                # pytree). The gather runs async under the decode tick
+                # it overlapped with (the Prefetcher's two-stage
+                # discipline); drain_spills() materializes at the next
+                # tick boundary or on demand before a restore.
+                self._pending_spills: list = []
+                self.host_spilled_pages = 0
+                self.host_restreamed_pages = 0
+                self.host_spill_bytes = 0
+                self.host_restream_bytes = 0
         else:
             # Dense: capacity is slot-granular; the scheduler grants/
             # frees one slot reservation per admission/retirement.
@@ -1376,6 +1425,54 @@ class Engine:
             k=cp(dcache.k), v=cp(dcache.v), lengths=dcache.lengths
         )
 
+    def _gather_page_step(self, cache, page, dcache=None):
+        """Pull pool page ``page`` (all layers, K and V; the draft pool
+        too on a speculative engine) into fresh [L, 1, ps, H, ·]
+        buffers — the device half of a spill. The page id rides as a
+        traced scalar (one compile serves every spill) and a quantized
+        pool gathers its int8 page AND the page's scale block in the
+        same pass (ISSUE 20: payload + scales travel as one unit)."""
+
+        def gp(pool):
+            return jax.tree.map(
+                lambda pl: jax.lax.dynamic_index_in_dim(
+                    pl, page, axis=1, keepdims=True
+                ),
+                pool,
+            )
+
+        out = (gp(cache.k), gp(cache.v))
+        if not self.spec_k:
+            return out
+        return out + (gp(dcache.k), gp(dcache.v))
+
+    def _scatter_page_step(self, cache, dst, payload, dcache=None):
+        """Write a previously gathered page payload into pool page
+        ``dst`` — the device half of a restream. ``payload`` is the
+        tuple :meth:`_gather_page_step` produced (round-tripped through
+        host numpy), so shapes/dtypes are fixed and only the page id is
+        traced: one compile serves every restore, and int8 payloads
+        land with their scale blocks in the same pass."""
+
+        def sp(pool, pay):
+            return jax.tree.map(
+                lambda pl, pg: jax.lax.dynamic_update_slice_in_dim(
+                    pl, pg, dst, axis=1
+                ),
+                pool, pay,
+            )
+
+        out = PagedKVCache(
+            k=sp(cache.k, payload[0]), v=sp(cache.v, payload[1]),
+            lengths=cache.lengths,
+        )
+        if not self.spec_k:
+            return out
+        return out, PagedKVCache(
+            k=sp(dcache.k, payload[2]), v=sp(dcache.v, payload[3]),
+            lengths=dcache.lengths,
+        )
+
     # -- host surface (the scheduler's API) ---------------------------------
     def _split(self):
         self._key, sub = jax.random.split(self._key)
@@ -1476,6 +1573,94 @@ class Engine:
             self.cache = self.compile_watch.call(
                 "copy_page", self._copy_page_jit, *args
             )
+
+    # -- host KV tier (ISSUE 20) --------------------------------------------
+    def spill_page(self, device_page: int, host_page: int, *,
+                   owner=None, tick: int = 0) -> None:
+        """DISPATCH the spill of pool page ``device_page`` into host
+        seat ``host_page``. The jitted gather runs asynchronously —
+        JAX's functional update pins the gathered buffers, so the
+        device page may be recycled (even rewritten by the very next
+        prefill) before the copy completes without corrupting the
+        payload. Materialization to host numpy happens at
+        :meth:`drain_spills` (the next tick boundary — the Prefetcher's
+        overlap discipline) or on demand before a restore. The host
+        tier's ledger bytes are charged HERE: dispatch is the
+        commitment."""
+        args = [self.cache, jnp.asarray(device_page, jnp.int32)]
+        if self.spec_k:
+            args.append(self.draft_cache)
+        payload = self.compile_watch.call(
+            "gather_page", self._gather_page_jit, *args
+        )
+        self._pending_spills.append((int(host_page), payload))
+        self.memledger.grant(
+            "kv_host_pages", self.page_bytes,
+            owner=owner, tick=tick, kind="spill",
+        )
+        self.host_spilled_pages += 1
+        self.host_spill_bytes += self.page_bytes
+
+    def drain_spills(self) -> int:
+        """Materialize every dispatched spill into the host store.
+        Called at tick boundaries so the device→host copies overlap
+        the decode tick they were dispatched under; a restore of a
+        still-pending page drains early instead of reading stale data.
+        Returns the number of pages landed."""
+        if not self._pending_spills:
+            return 0
+        pending, self._pending_spills = self._pending_spills, []
+        for host_page, payload in pending:
+            self._host_store[host_page] = jax.tree.map(np.asarray, payload)
+        return len(pending)
+
+    def restore_page(self, host_page: int, device_page: int, *,
+                     release: bool = False, kind: str = "restream",
+                     owner=None, tick: int = 0) -> None:
+        """Restream host seat ``host_page`` into pool page
+        ``device_page`` (whole-page write: all layers, K and V, scale
+        blocks and draft pool included). ``release=True`` consumes the
+        payload and refunds its ledger bytes (a parked victim's resume);
+        ``release=False`` leaves the seat resident (a prefix entry keeps
+        serving hits until promotion frees it)."""
+        if any(hp == host_page for hp, _ in self._pending_spills):
+            self.drain_spills()
+        payload = self._host_store[host_page]
+        args = [self.cache, jnp.asarray(device_page, jnp.int32), payload]
+        if self.spec_k:
+            self.cache, self.draft_cache = self.compile_watch.call(
+                "scatter_page", self._scatter_page_jit, *args,
+                self.draft_cache,
+            )
+        else:
+            self.cache = self.compile_watch.call(
+                "scatter_page", self._scatter_page_jit, *args
+            )
+        self.host_restreamed_pages += 1
+        self.host_restream_bytes += self.page_bytes
+        if release:
+            del self._host_store[host_page]
+            self.memledger.free(
+                "kv_host_pages", self.page_bytes,
+                owner=owner, kind=kind,
+            )
+
+    def host_free(self, host_page: int, *, kind: str,
+                  owner=None, tick: int = 0) -> None:
+        """Drop host seat ``host_page``'s payload without restoring it
+        (promotion made it redundant, cold eviction reclaimed it, or a
+        resume's prefix hit covered it) and refund its ledger bytes."""
+        if self._pending_spills and any(
+            hp == host_page for hp, _ in self._pending_spills
+        ):
+            self._pending_spills = [
+                (hp, p) for hp, p in self._pending_spills if hp != host_page
+            ]
+        else:
+            self._host_store.pop(host_page, None)
+        self.memledger.free(
+            "kv_host_pages", self.page_bytes, owner=owner, kind=kind,
+        )
 
     def spec_draft(self, active, temp, topk) -> None:
         """Phase 1 of a speculative tick: draft ``spec_k`` tokens per
@@ -1759,6 +1944,19 @@ class Engine:
                 lengths=jnp.zeros_like(self.draft_cache.lengths),
             )
         if self.paged:
+            if self.host_pages:
+                # The host tier empties with the pool: drop payloads
+                # (pending dispatches included) and refund every byte
+                # still charged, keeping per-tier conservation exact.
+                self._pending_spills.clear()
+                self._host_store.clear()
+                held = self.memledger.held("kv_host_pages")
+                if held:
+                    self.memledger.free("kv_host_pages", held, kind="reset")
+                self.host_spilled_pages = 0
+                self.host_restreamed_pages = 0
+                self.host_spill_bytes = 0
+                self.host_restream_bytes = 0
             self.allocator.reset()
         else:
             # Dense slot reservations are the scheduler's grants; a

@@ -52,6 +52,25 @@ is the sizing rule the roofline model and the bench capacity sweep
 share). Recycled pages need no scale scrubbing for the same reason
 rows need no zeroing: the mask defines validity, and every valid row's
 scale was written by that row's own quantize-on-write.
+
+HOST TIER (ISSUE 20). HBM pages are the scarce resource; host RAM is
+the next 10×. ``host_pages > 0`` gives the allocator a second page
+namespace — host page ids are bookkeeping handles whose PAYLOADS live
+on the engine as numpy pytrees (int8 payload + scale blocks travel as
+one unit, like every other page move). Cold K/V spills there instead
+of dying: a preempted victim's filled pages park (:meth:`park_pages`)
+so resume restreams them instead of re-prefilling the whole feed, and
+prefix-index entries whose last HBM reader frees migrate
+(:meth:`spill_prefix_on_free`) so the index survives pool reclaim — a
+later admit hits the host tier and the plan carries ``restream`` pairs
+instead of shared-page mappings. Tiers never share refcounts: a host
+hit maps only fresh private device pages (no COW reserve), and the
+entry stays host-resident until :meth:`register_prefix` promotes it
+back onto the re-prefilled device pages. All host grants are
+all-or-nothing, exactly like admission; when the host tier is full,
+:meth:`_reclaim_host` evicts the coldest host prefix entries (never
+parked records) or the spill simply does not happen and behaviour
+degrades to pre-tiering recompute.
 """
 
 from __future__ import annotations
@@ -268,6 +287,13 @@ class AdmitPlan:
 
     shared_tokens: int
     pages: tuple
+    # ISSUE 20: ``(host_page, device_page)`` pairs to restream before
+    # the first prefill chunk — non-empty iff the prefix hit landed on
+    # a host-tier entry. The device pages are fresh private pages from
+    # ``pages`` (position order); the engine restores the host payload
+    # into them and the write floor masks re-writes exactly as for an
+    # HBM hit.
+    restream: tuple = ()
 
     @property
     def pages_granted(self) -> int:
@@ -294,8 +320,24 @@ def _prefix_hashes(tokens) -> list:
 
 @dataclasses.dataclass
 class _PrefixEntry:
-    tokens: tuple  # the exact prefix (full compare before mapping)
-    pages: tuple   # pages covering it, in position order
+    tokens: tuple     # the exact prefix (full compare before mapping)
+    pages: tuple      # pages covering it, in position order
+    # ISSUE 20: which namespace ``pages`` indexes — "hbm" page ids are
+    # device pool rows (refcounted, block-table mappable); "host" page
+    # ids name engine-held numpy payloads and are NEVER refcounted or
+    # mapped — a hit restreams them into fresh device pages instead.
+    tier: str = "hbm"
+
+
+@dataclasses.dataclass(frozen=True)
+class _ParkedKV:
+    """A preemption victim's spilled K/V: ``host_pages`` (position
+    order) hold rows ``[0, fill)`` where ``fill`` was the victim's
+    device fill watermark (``prompt + generated - 1``) at eviction.
+    Resume restreams these instead of re-prefilling the feed."""
+
+    host_pages: tuple
+    fill: int
 
 
 class PageAllocator:
@@ -325,9 +367,13 @@ class PageAllocator:
     """
 
     def __init__(self, num_pages: int, page_size: int,
-                 pages_per_slot: int, slots: int):
+                 pages_per_slot: int, slots: int, *,
+                 host_pages: int = 0):
         if num_pages < 1:
             raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        if host_pages < 0:
+            raise ValueError(f"host_pages must be >= 0, got {host_pages}")
+        self.host_pages = host_pages
         self.num_pages = num_pages
         self.page_size = page_size
         self.pages_per_slot = pages_per_slot
@@ -369,12 +415,23 @@ class PageAllocator:
         # per-request/per-tenant roll-up and the eviction ranking.
         self._slot_owner: dict[int, tuple] = {}  # slot -> (rid, tenant)
         self._prefix_touch: dict[tuple, int] = {}  # index key -> tick
+        # ISSUE 20 host tier: an independent page-id namespace. The
+        # allocator owns the ids; the ENGINE owns the payloads (numpy
+        # pytrees) and the ledger charges — so these structures carry
+        # no ledger wiring of their own.
+        self.host_free: list[int] = list(range(self.host_pages))[::-1]
+        self._host_page_keys: dict[int, set] = {}  # host page -> keys
+        self._parked: dict[Any, _ParkedKV] = {}    # rid -> parked record
         # Stats (the scheduler's kv gauges + bench's prefix_hit_rate).
         self.cow_copies = 0
         self.prefix_hits = 0
         self.admissions = 0
         self.shared_tokens_total = 0
         self.prompt_tokens_total = 0
+        self.host_prefix_hits = 0       # admits served from the host tier
+        self.parked_spills = 0          # preemption victims parked to host
+        self.spilled_prefix_entries = 0  # entries migrated HBM -> host
+        self.promoted_entries = 0       # entries promoted host -> HBM
 
     # -- capacity -----------------------------------------------------------
     @property
@@ -404,6 +461,15 @@ class PageAllocator:
             if self.prompt_tokens_total
             else 0.0
         )
+
+    @property
+    def host_pages_in_use(self) -> int:
+        return self.host_pages - len(self.host_free)
+
+    @property
+    def host_resident_entries(self) -> int:
+        """Prefix-index entries whose K/V lives only in host RAM."""
+        return sum(1 for e in self._index.values() if e.tier == "host")
 
     def pages_for(self, prompt_len: int, max_new_tokens: int) -> int:
         return pages_needed(prompt_len, max_new_tokens, self.page_size)
@@ -449,8 +515,15 @@ class PageAllocator:
                 f"shrink prompt + max_new_tokens or grow num_pages"
             )
         shared_tokens, entry = self._find_shared_prefix(prompt)
-        shared_pages = list(entry.pages) if entry is not None else []
-        partial_shared = bool(shared_tokens % self.page_size)
+        # ISSUE 20: a host-tier hit maps NO shared pages — the prefix
+        # K/V restreams into fresh private pages (refcounts and COW
+        # never span tiers), so the full page count is an "own" need
+        # and no COW reserve is taken (restored pages have one mapper).
+        host_hit = entry is not None and entry.tier == "host"
+        shared_pages = (
+            [] if host_hit else list(entry.pages) if entry is not None else []
+        )
+        partial_shared = bool(shared_tokens % self.page_size) and not host_hit
         own_needed = need_total - len(shared_pages)
         # The whole requirement up front — fresh pages now, plus one
         # reserved free page per mapped partial page (its future COW
@@ -491,36 +564,62 @@ class PageAllocator:
                     kind="cow_reserve",
                 )
         self.admissions += 1
+        restream = ()
         if shared_tokens:
             self.prefix_hits += 1
             # A hit refreshes the entry's recency — a prefix actively
-            # being re-mapped is NOT an eviction candidate (ISSUE 18).
+            # being re-mapped is NOT an eviction candidate (ISSUE 18),
+            # on either tier (a warm host entry must not be reclaimed
+            # by the next park while it is still paying for itself).
             hashes = _prefix_hashes(prompt[:shared_tokens])
             self._prefix_touch[(shared_tokens, hashes[-1])] = tick
+        if host_hit:
+            # The hit's pages restream (engine restore) into the first
+            # ``len(entry.pages)`` fresh device pages, position order.
+            # The entry STAYS host-resident — it keeps serving hits
+            # until register_prefix promotes it onto device pages.
+            restream = tuple(
+                (int(h), int(mapping[i])) for i, h in enumerate(entry.pages)
+            )
+            self.host_prefix_hits += 1
         self.shared_tokens_total += shared_tokens
         self.prompt_tokens_total += len(prompt)
-        return AdmitPlan(shared_tokens=shared_tokens, pages=tuple(mapping))
+        return AdmitPlan(
+            shared_tokens=shared_tokens, pages=tuple(mapping),
+            restream=restream,
+        )
 
-    def register_prefix(self, slot: int, prompt, *, tick: int = 0) -> None:
+    def register_prefix(self, slot: int, prompt, *, tick: int = 0) -> list:
         """Index ``slot``'s now-fully-prefilled prompt so later admits
         can share it: one entry per page-aligned prefix plus the full
         prompt (covering its partially-filled last page). Call only
         AFTER the final prefill chunk executed — an entry must never
-        advertise K/V that is not on the device yet."""
+        advertise K/V that is not on the device yet.
+
+        ISSUE 20: a host-tier entry for the same key is PROMOTED — the
+        prefix is resident on device again (this slot just prefilled or
+        restreamed it), so the host copy is redundant. Returns the host
+        page ids freed by promotion (the engine drops their payloads);
+        pre-tiering callers may ignore the (empty) list."""
         prompt = tuple(int(t) for t in prompt)
         mapping = self._slot_pages.get(slot)
         if mapping is None:
-            return
+            return []
         hashes = _prefix_hashes(prompt)
         ps = self.page_size
         plen = len(prompt)
         boundaries = [k * ps for k in range(1, plen // ps + 1)]
         if plen % ps:
             boundaries.append(plen)
+        freed_host: list[int] = []
         for n in boundaries:
             key = (n, hashes[n])
-            if key in self._index:
-                continue  # first registration wins; content is identical
+            prev = self._index.get(key)
+            if prev is not None:
+                if prev.tier != "host":
+                    continue  # first registration wins; content identical
+                freed_host += self._evict_host_entry(key, prev)
+                self.promoted_entries += 1
             pages = tuple(mapping[: -(-n // ps)])
             self._index[key] = _PrefixEntry(
                 tokens=prompt[:n], pages=pages
@@ -528,6 +627,154 @@ class PageAllocator:
             self._prefix_touch[key] = tick
             for p in pages:
                 self._page_keys.setdefault(p, set()).add(key)
+        return freed_host
+
+    # -- host tier (ISSUE 20) ----------------------------------------------
+    def spill_prefix_on_free(self, slot: int):
+        """Plan the host migration of prefix entries about to die with
+        ``slot``'s pages. Call BEFORE :meth:`free_slot`: entries citing
+        a sole-reader (refcount 1) page of ``slot`` would be
+        invalidated by the free — instead, every device page those
+        entries cite (still-shared pages included, so the host copy is
+        self-contained) gets a host page, the entries are rewritten
+        tier="host", and the device bookkeeping for them is dropped so
+        the eventual free of a surviving shared page cannot kill them.
+
+        Returns ``(copies, evicted)``: ``copies`` is the
+        ``[(device_page, host_page)]`` list the engine must gather
+        BEFORE the device pages are recycled (all-or-nothing — an
+        undersized host tier returns ``([], evicted)`` and the entries
+        die exactly as before tiering); ``evicted`` is host pages freed
+        by cold-entry reclaim, whose payloads the engine must drop."""
+        if not self.host_pages:
+            return [], []
+        dying = [
+            p for p in self._slot_pages.get(slot, [])
+            if self.refcount[p] == 1 and self._page_keys.get(p)
+        ]
+        if not dying:
+            return [], []
+        keys: set = set()
+        for p in dying:
+            keys |= self._page_keys[p]
+        entries = [(k, self._index[k]) for k in sorted(keys)
+                   if k in self._index]
+        pages: list[int] = []
+        seen: set = set()
+        for _k, e in entries:
+            for p in e.pages:
+                if p not in seen:
+                    seen.add(p)
+                    pages.append(int(p))
+        evicted = self._reclaim_host(len(pages))
+        if evicted is None:
+            return [], []
+        mapping = {p: self.host_free.pop() for p in pages}
+        for k, e in entries:
+            for p in e.pages:
+                s = self._page_keys.get(p)
+                if s is not None:
+                    s.discard(k)
+                    if not s:
+                        del self._page_keys[p]
+            moved = _PrefixEntry(
+                tokens=e.tokens,
+                pages=tuple(mapping[int(p)] for p in e.pages),
+                tier="host",
+            )
+            self._index[k] = moved
+            for h in moved.pages:
+                self._host_page_keys.setdefault(h, set()).add(k)
+        self.spilled_prefix_entries += len(entries)
+        return [(p, mapping[p]) for p in pages], evicted
+
+    def park_pages(self, rid, slot: int, fill: int):
+        """Reserve host pages for a preemption victim's filled rows
+        ``[0, fill)`` — all-or-nothing, after evicting cold host prefix
+        entries if needed (parked records are never evicted: they are
+        promised resumes, not opportunistic caches). Call BEFORE
+        :meth:`free_slot`. Returns ``(copies, evicted)`` like
+        :meth:`spill_prefix_on_free`, or ``None`` when the host tier
+        cannot hold the spill (caller falls back to recompute)."""
+        if not self.host_pages or fill <= 0:
+            return None
+        mapping = self._slot_pages.get(slot)
+        npages = -(-fill // self.page_size)
+        if mapping is None or npages > len(mapping):
+            return None
+        evicted = self._reclaim_host(npages)
+        if evicted is None:
+            return None
+        host = [self.host_free.pop() for _ in range(npages)]
+        self._parked[rid] = _ParkedKV(host_pages=tuple(host), fill=fill)
+        self.parked_spills += 1
+        return [(int(mapping[i]), host[i]) for i in range(npages)], evicted
+
+    def peek_parked(self, rid):
+        """The parked record for ``rid`` (or None), ids still owned."""
+        return self._parked.get(rid)
+
+    def take_parked(self, rid):
+        """Pop ``rid``'s parked record, recycling its host page ids.
+        Call only AFTER the payloads were consumed (engine restore or
+        drop) — the ids become reusable by the next spill immediately."""
+        rec = self._parked.pop(rid, None)
+        if rec is not None:
+            self.host_free.extend(rec.host_pages)
+        return rec
+
+    def drop_parked(self, rid) -> list:
+        """Discard ``rid``'s parked record (shed / superseded request).
+        Returns the freed host page ids so the engine can drop their
+        payloads."""
+        rec = self._parked.pop(rid, None)
+        if rec is None:
+            return []
+        self.host_free.extend(rec.host_pages)
+        return list(rec.host_pages)
+
+    def _reclaim_host(self, need: int):
+        """Free host pages until ``need`` are available by evicting the
+        coldest host-tier prefix entries (by ``_prefix_touch``; parked
+        records are untouchable). Returns the evicted host page ids
+        ([] when already satisfied) or ``None`` when ``need`` is
+        unreachable — in which case NOTHING was evicted (the
+        reachability check precedes any eviction, keeping spills
+        all-or-nothing)."""
+        if need <= len(self.host_free):
+            return []
+        if len(self.host_free) + len(self._host_page_keys) < need:
+            return None
+        order = sorted(
+            {k for ks in self._host_page_keys.values() for k in ks},
+            key=lambda k: (self._prefix_touch.get(k, 0), k[0]),
+        )
+        freed: list[int] = []
+        for key in order:
+            if len(self.host_free) >= need:
+                break
+            entry = self._index.get(key)
+            if entry is None or entry.tier != "host":
+                continue
+            freed += self._evict_host_entry(key, entry)
+        return freed
+
+    def _evict_host_entry(self, key, entry) -> list:
+        """Drop one host-tier entry; host pages left keyless return to
+        ``host_free``. Returns them (payload owners must drop them)."""
+        self._index.pop(key, None)
+        self._prefix_touch.pop(key, None)
+        freed: list[int] = []
+        for h in entry.pages:
+            s = self._host_page_keys.get(h)
+            if s is None:
+                continue
+            s.discard(key)
+            if not s:
+                del self._host_page_keys[h]
+                self.host_free.append(h)
+                freed.append(int(h))
+        return freed
 
     def mapped_tokens(self) -> np.ndarray:
         """Per-slot writable capacity (mapped pages × page_size) as an

@@ -153,6 +153,13 @@ def warm_engine(engine, *, register_costs: bool = False) -> None:
             # here or the first real divergence pays it inside the
             # timed window.
             engine.copy_page(0, 0)
+            if getattr(engine, "host_pages", 0):
+                # The host tier's gather/scatter pair likewise: pay
+                # both compiles with a page-0 round trip (restore
+                # rewrites exactly what spill read — a semantic no-op).
+                engine.spill_page(0, 0)
+                engine.drain_spills()
+                engine.restore_page(0, 0, release=True, kind="warm")
         if register_costs:
             engine.register_roofline()
     engine.reset()
@@ -228,6 +235,13 @@ class _Live:
     # ranking orders by (coldest first).
     last_touch: int = 0
     park_tick: int = 0
+    # Host-tier resume telemetry (ISSUE 20): how the last resume
+    # rebuilt this slot's cache ("restream" from parked host pages /
+    # "recompute" through chunked re-prefill) and when it was
+    # re-admitted — cleared once the resume completes (first
+    # post-resume token), closing the per-mode duration sample.
+    resume_mode: str = ""
+    resume_t: float = 0.0
 
     def feed_tokens(self) -> list:
         """What prefill feeds the device: the prompt, or the resume
@@ -400,6 +414,16 @@ class Server:
         self._memledger = getattr(engine, "memledger", None)
         self._held_peak = 0
         self._headroom_min_pct: float | None = None
+        # Host KV tier (ISSUE 20): preemption victims park their pages
+        # in host RAM and resume by restreaming instead of recomputing;
+        # prefix entries migrate there instead of dying with their HBM
+        # pages. Per-mode resume durations feed the p95
+        # restream-vs-recompute comparison on the bench record line.
+        self._host_tier = self._paged and getattr(engine, "host_pages", 0) > 0
+        self._host_held_peak = 0
+        self.resume_durations: dict[str, list] = {
+            "restream": [], "recompute": [],
+        }
         # Per-slot sampling-control arrays (host; refreshed on admit/retire).
         s = engine.slots
         self._temp = np.zeros((s,), np.float32)
@@ -672,26 +696,59 @@ class Server:
             live.base = min(plan.shared_tokens, len(feed) - 1)
             self._temp[slot] = live.req.temperature
             self._topk[slot] = live.req.top_k
+            if plan.restream:
+                # Host-tier prefix hit (ISSUE 20): restream the entry's
+                # pages into the freshly granted device pages before the
+                # first prefill chunk; the write floor then masks
+                # re-writes below shared_tokens exactly as for an HBM
+                # hit. The entry stays host-resident (release=False) —
+                # it keeps serving hits until promotion frees it.
+                for hp, dp in plan.restream:
+                    self.engine.restore_page(
+                        hp, dp, owner=live.req.rid, tick=self.tick
+                    )
+                obs.counter("kv_host_restreams", len(plan.restream))
             if self._ledger is not None:
                 self._ledger.event(
                     live.req.rid, "slot_bind", slot=slot, tick=self.tick,
                     resumed=bool(live.tokens),
                     shared_tokens=plan.shared_tokens,
                     pages=plan.pages_granted,
+                    restreamed_pages=len(plan.restream),
                 )
             if live.tokens:
                 # Resumed after a preemption: queue_wait/TTFT were
                 # already delivered in the first stint — re-recording
                 # them would double-count the request in the histograms.
-                self.policy.resumes += 1
+                resume_mode = "recompute"
+                if self._host_tier:
+                    rec = alloc.peek_parked(live.req.rid)
+                    if rec is not None:
+                        if self._restream_parked(slot, live, plan, rec):
+                            resume_mode = "restream"
+                        alloc.take_parked(live.req.rid)
+                live.resume_mode = resume_mode
+                live.resume_t = now
+                if self.policy is not None:
+                    self.policy.resumes += 1
                 obs.instant(
                     "request_resumed", generated=len(live.tokens),
                     **self._span_attrs(live.req),
                 )
+                if self._host_tier:
+                    # The restream-vs-recompute OUTCOME instant
+                    # (ISSUE 20): which rebuild path this resume took,
+                    # joinable to the per-mode duration windows.
+                    obs.instant(
+                        "resume_" + resume_mode,
+                        generated=len(live.tokens),
+                        **self._span_attrs(live.req),
+                    )
                 if self._ledger is not None:
                     self._ledger.event(
                         live.req.rid, "preempt_resume", slot=slot,
                         tick=self.tick, generated=len(live.tokens),
+                        mode=resume_mode if self._host_tier else "recompute",
                     )
             else:
                 obs.span_at(
@@ -733,6 +790,28 @@ class Server:
         live = self.live.pop(slot)
         alloc = self.engine.allocator
         owned, shared = alloc.slot_page_stats(slot)
+        spilled_pages = 0
+        if self._host_tier:
+            # ISSUE 20: park the victim's filled rows in host RAM
+            # BEFORE the pages recycle — the spill gathers dispatch
+            # async (the device buffers they read stay pinned even if
+            # the very next admit rewrites the pages) and land at the
+            # next tick boundary. All-or-nothing: an undersized host
+            # tier parks nothing and resume recomputes, as before
+            # tiering. Entries dying with the slot migrate too.
+            planned = alloc.park_pages(
+                live.req.rid, slot, live.cache_fill()
+            )
+            if planned is not None:
+                copies, evicted = planned
+                for hp in evicted:
+                    self.engine.host_free(hp, kind="host_evict")
+                for dp, hp in copies:
+                    self.engine.spill_page(
+                        dp, hp, owner=live.req.rid, tick=self.tick
+                    )
+                spilled_pages = len(copies)
+            self._spill_dying_prefixes(slot, owner=live.req.rid)
         alloc.free_slot(slot)
         self.free.append(slot)
         self._temp[slot] = 0.0
@@ -766,6 +845,7 @@ class Server:
             generated=len(live.tokens),
             pages_freed=owned,
             pages_unshared=shared,
+            pages_spilled=spilled_pages,
             **self._span_attrs(live.req),
         )
         if self._ledger is not None:
@@ -778,8 +858,84 @@ class Server:
             )
         if self.stream is not None:
             self.stream.inc("serve_preemptions")
-        self.policy.preemptions += 1
-        self.policy.requeue_front(live)
+        if self.policy is not None:
+            self.policy.preemptions += 1
+            self.policy.requeue_front(live)
+        else:
+            # Direct preemption on a policy-less server (tests, manual
+            # eviction): FIFO resume order, front of the plain queue.
+            self.queue.appendleft(live)
+
+    def _spill_dying_prefixes(self, slot: int, *, owner=None) -> None:
+        """Migrate prefix entries that would die with ``slot``'s pages
+        into the host tier (ISSUE 20) — call immediately BEFORE
+        ``free_slot``. Best-effort and all-or-nothing: when the host
+        tier cannot hold the migration, the entries die exactly as
+        before tiering."""
+        if not self._host_tier:
+            return
+        copies, evicted = self.engine.allocator.spill_prefix_on_free(slot)
+        for hp in evicted:
+            self.engine.host_free(hp, kind="host_evict")
+        for dp, hp in copies:
+            self.engine.spill_page(dp, hp, owner=owner, tick=self.tick)
+        if copies:
+            obs.counter("kv_prefix_spills", len(copies))
+
+    def _restream_parked(self, slot: int, live: _Live, plan, rec) -> bool:
+        """Rebuild a resumed victim's cache rows ``[shared, fill)`` from
+        its parked host pages instead of re-prefilling the feed
+        (ISSUE 20). Rows below the admission's shared floor are already
+        on device (prefix hit — possibly itself a restream); the
+        boundary page COWs out first when still shared, and every
+        restored page is written WHOLE (parked rows below the floor are
+        bit-identical to the resident ones — K/V is a deterministic
+        function of tokens and positions — and junk rows past the fill
+        stay mask-hidden, exactly as after a normal prefill). On
+        success the feed base jumps to the fill watermark, so the next
+        prefill chunk is the single displaced decode row: the
+        recompute path's bit-match discipline, minus the recompute.
+        Returns False when the prefix hit already covers every parked
+        row (payloads dropped unused)."""
+        eng = self.engine
+        alloc = eng.allocator
+        ps = alloc.page_size
+        s = plan.shared_tokens
+        fill = rec.fill
+        rid = live.req.rid
+        if s >= fill:
+            for hp in rec.host_pages:
+                eng.host_free(hp, kind="restream_unused", owner=rid)
+            return False
+        if s % ps:
+            # The boundary page holds shared rows below ``s``; a
+            # whole-page restore over a still-shared page would corrupt
+            # the other readers — COW it out first (admission reserved
+            # the free page, the same guarantee a prefill write gets).
+            pair = alloc.cow_before_write(slot, s)
+            if pair is not None:
+                eng.copy_page(*pair)
+                obs.counter("kv_cow_copies")
+                if self._ledger is not None:
+                    self._ledger.event(
+                        rid, "cow_copy", tick=self.tick,
+                        src=pair[0], dst=pair[1], phase="restream",
+                    )
+        bt = alloc.block_tables[slot]
+        for pi in range(s // ps, (fill - 1) // ps + 1):
+            eng.restore_page(
+                int(rec.host_pages[pi]), int(bt[pi]),
+                release=True, kind="restream", owner=rid, tick=self.tick,
+            )
+        for pi in range(0, s // ps):
+            # Fully below the shared floor: the device prefix hit
+            # already provides these rows — drop the payloads.
+            eng.host_free(
+                int(rec.host_pages[pi]), kind="restream_unused", owner=rid
+            )
+        live.base = fill
+        live.floor = fill
+        return True
 
     def _prefill_chunk_tick(self) -> None:
         """Advance every prefilling slot by ONE prompt chunk (one
@@ -861,12 +1017,34 @@ class Server:
                 live.last_touch = self.tick
         for slot, live in finishing:
             del self.prefilling[slot]
-            alloc.register_prefix(slot, live.feed_tokens(), tick=self.tick)
+            promoted = alloc.register_prefix(
+                slot, live.feed_tokens(), tick=self.tick
+            )
+            for hp in promoted:
+                # ISSUE 20: the prompt's prefix is resident on device
+                # again — the allocator promoted its host entries, and
+                # the freed host seats drop their payloads here.
+                self.engine.host_free(
+                    hp, kind="promote", owner=live.req.rid
+                )
             if live.tokens:
                 # Resumed after a preemption: this chunk's sampled
                 # token IS the decode step the eviction displaced —
                 # append it; TTFT was already delivered before the park.
                 live.tokens.append(int(first[slot]))
+                if live.resume_mode:
+                    # Close the resume: admission → first post-resume
+                    # token, by rebuild mode (ISSUE 20 — the p95
+                    # restream-vs-recompute comparison's sample).
+                    dur = t_first - live.resume_t
+                    self.resume_durations.setdefault(
+                        live.resume_mode, []
+                    ).append(dur)
+                    if self.stream is not None:
+                        self.stream.observe(
+                            f"resume_{live.resume_mode}", dur
+                        )
+                    live.resume_mode = ""
             else:
                 live.first_token_t = t_first
                 live.tokens = [int(first[slot])]
@@ -996,7 +1174,10 @@ class Server:
             # Unmap the slot's pages: refcounts drop, sole-owner pages
             # return to the free list (recycled WITHOUT zeroing — the
             # mask defines validity), prefix-index entries whose pages
-            # died are invalidated.
+            # died are invalidated — unless the host tier catches them
+            # first (ISSUE 20: a sole-reader prefix migrates instead of
+            # dying, so the index survives HBM reclaim).
+            self._spill_dying_prefixes(slot, owner=req.rid)
             self.engine.allocator.free_slot(slot)
         elif self._memledger is not None:
             self._memledger.free(
@@ -1344,6 +1525,13 @@ class Server:
         self._held_peak = max(self._held_peak, int(held))
         head = self._kv_headroom()
         gauges = {"hbm_held_bytes": float(held)}
+        if self._host_tier:
+            # Host-tier watermark, sampled per tick like the HBM peak
+            # (ISSUE 20) — the ``host_held_peak_bytes`` the diff gate
+            # compares must not depend on when stats() was last called.
+            host_held = int(ml.held("kv_host_pages"))
+            self._host_held_peak = max(self._host_held_peak, host_held)
+            gauges["host_held_bytes"] = float(host_held)
         sub = "kv_pages" if self._paged else "kv_slots"
         kv_held = ml.held(sub) + (
             ml.held("kv_cow_reserve") if self._paged else 0.0
@@ -1434,6 +1622,19 @@ class Server:
             # invariant shows up as leaked dead entries, not silence.
             "dead_prefix_entries": dead,
         }
+        if self._host_tier:
+            # Host-tier pressure facts (ISSUE 20): the capacity verdict
+            # names whether this exhaustion is HBM-only (host seats
+            # still free — spills can relieve) or squeezes both tiers.
+            out["host_free_pages"] = len(alloc.host_free)
+            out["host_pages"] = alloc.host_pages
+            out["host_parked_records"] = len(alloc._parked)
+            out["host_resident_entries"] = alloc.host_resident_entries
+        out["tier_pressure"] = (
+            "both_tiers"
+            if self._host_tier and not alloc.host_free
+            else "hbm_only"
+        )
         if self._memledger is not None:
             out["subsystems"] = self._memledger.decompose()
         out.update(self._kv_headroom())
@@ -1444,10 +1645,13 @@ class Server:
         pages are all refcount 1 (only the registrant still maps them —
         reclaimable by retiring one idle slot) and entries citing a
         page at refcount 0 (impossible by construction; counted so a
-        regression surfaces)."""
+        regression surfaces). Host-tier entries are excluded — their
+        page ids name host seats, not refcounted device pages."""
         alloc = self.engine.allocator
         sole = dead = 0
         for entry in alloc._index.values():
+            if entry.tier != "hbm":
+                continue
             refs = [int(alloc.refcount[p]) for p in entry.pages]
             if any(r == 0 for r in refs):
                 dead += 1
@@ -1458,6 +1662,12 @@ class Server:
     def _run_tick(self) -> None:
         """One loop iteration: admit, prefill chunk (paged), gauges,
         decode, SLO evaluation."""
+        if self._host_tier:
+            # Land last tick's dispatched spills (ISSUE 20): the
+            # device→host copies ran under the decode tick they were
+            # dispatched with (the Prefetcher's two-stage overlap);
+            # materializing here costs only the memcpy, never the wait.
+            self.engine.drain_spills()
         self._admit()
         if self._paged:
             self._prefill_chunk_tick()
@@ -1626,11 +1836,18 @@ class Server:
           all refcount 1 — nobody shares it anymore; retiring its one
           mapper returns the whole run. Nested page-aligned entries of
           the same registration are deduped to the longest.
+        - ``host_prefix`` (ISSUE 20): a prefix entry already spilled to
+          the host tier. Its bytes are host RAM, not HBM — reclaiming
+          it buys host capacity and forfeits a restream hit.
+
+        Every candidate carries its current ``tier`` ("hbm", "host",
+        or "none" for parked victims whose pages were spilled/freed).
         """
         pb = self.engine.page_bytes
         out = []
         if self.policy is not None and pb:
             alloc = self.engine.allocator
+            parked = getattr(alloc, "_parked", {})
             for st in self.policy._tiers.values():
                 for q in st.queues.values():
                     for live in q:
@@ -1645,6 +1862,8 @@ class Server:
                             "tenant": live.req.tenant or "",
                             "bytes": int(pages * pb),
                             "last_touch_tick": live.park_tick,
+                            "tier": "host" if live.req.rid in parked
+                            else "none",
                         })
         if self._paged and pb:
             alloc = self.engine.allocator
@@ -1656,10 +1875,17 @@ class Server:
                     "tenant": live.req.tenant or "",
                     "bytes": int(owned * pb),
                     "last_touch_tick": live.last_touch,
+                    "tier": "hbm",
                 })
             best: dict[int, tuple] = {}
             for key, entry in alloc._index.items():
                 if not entry.pages:
+                    continue
+                if entry.tier != "hbm":
+                    # Host-resident entry: its page ids index the HOST
+                    # namespace — running them through the device
+                    # refcount would read the wrong pages. Reported
+                    # below as its own candidate kind.
                     continue
                 if any(int(alloc.refcount[p]) != 1 for p in entry.pages):
                     continue
@@ -1672,6 +1898,22 @@ class Server:
                     "key": f"prefix[{key[0]}t]",
                     "bytes": int(len(entry.pages) * pb),
                     "last_touch_tick": alloc._prefix_touch.get(key, 0),
+                    "tier": "hbm",
+                })
+            hbest: dict[int, tuple] = {}
+            for key, entry in alloc._index.items():
+                if entry.tier != "host" or not entry.pages:
+                    continue
+                first = entry.pages[0]
+                if first not in hbest or key[0] > hbest[first][0][0]:
+                    hbest[first] = (key, entry)
+            for key, entry in hbest.values():
+                out.append({
+                    "kind": "host_prefix",
+                    "key": f"prefix[{key[0]}t]",
+                    "bytes": int(len(entry.pages) * pb),
+                    "last_touch_tick": alloc._prefix_touch.get(key, 0),
+                    "tier": "host",
                 })
         elif not self._paged and self.engine.slot_bytes:
             for live in self.live.values():
@@ -1681,6 +1923,7 @@ class Server:
                     "tenant": live.req.tenant or "",
                     "bytes": int(self.engine.slot_bytes),
                     "last_touch_tick": live.last_touch,
+                    "tier": "hbm",
                 })
         out.sort(key=lambda c: (c["last_touch_tick"],
                                 str(c.get("rid", c.get("key", "")))))
@@ -1715,6 +1958,19 @@ class Server:
             out.pop("hbm_held_bytes", None)  # duplicate of held_bytes
         if self._headroom_min_pct is not None:
             out["kv_headroom_min_pct"] = self._headroom_min_pct
+        if self._host_tier:
+            # Host-tier ledger view (ISSUE 20). ``restream_bytes`` is
+            # the key name the obs diff gate reports on — keep it.
+            eng = self.engine
+            held = int(ml.held("kv_host_pages"))
+            self._host_held_peak = max(self._host_held_peak, held)
+            out["host_held_bytes"] = held
+            out["host_held_peak_bytes"] = int(self._host_held_peak)
+            out["host_capacity_bytes"] = int(
+                ml.capacity("kv_host_pages") or 0
+            )
+            out["spill_bytes_total"] = int(eng.host_spill_bytes)
+            out["restream_bytes"] = int(eng.host_restream_bytes)
         per_req: dict[str, dict] = {}
         per_tenant: dict[str, int] = {}
         if self._paged and self.engine.page_bytes:
@@ -1835,6 +2091,28 @@ class Server:
                 prefix_pages_shared_peak=self._pages_shared_peak,
                 kv_cow_copies=alloc.cow_copies,
             )
+            if self._host_tier:
+                # Host-tier roll-up (ISSUE 20): tier occupancy plus the
+                # spill/restream traffic and where prefix hits landed.
+                eng = self.engine
+                out.update(
+                    kv_host_pages=alloc.host_pages,
+                    kv_host_pages_in_use=alloc.host_pages_in_use,
+                    host_spilled_pages=eng.host_spilled_pages,
+                    host_restreamed_pages=eng.host_restreamed_pages,
+                    host_prefix_hits=alloc.host_prefix_hits,
+                    parked_spills=alloc.parked_spills,
+                    spilled_prefix_entries=alloc.spilled_prefix_entries,
+                    promoted_entries=alloc.promoted_entries,
+                )
+            # Resume-path p95s (ISSUE 20 headline): recorded for every
+            # paged server — an untiered run yields the recompute p95
+            # the bench compares the restream p95 against.
+            for mode, durs in sorted(self.resume_durations.items()):
+                if durs:
+                    out[f"resume_{mode}_p95_s"] = round(
+                        float(np.percentile(np.asarray(durs), 95)), 6
+                    )
         if self.shed:
             # Cause breakdown (ISSUE 16 satellite): ``requests_shed``
             # is a dict — total plus the two named reasons (bounded

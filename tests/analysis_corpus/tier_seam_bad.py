@@ -1,0 +1,16 @@
+"""Corpus: tier-seam fires exactly once — a marked device↔host
+page-copy seam that ships a page across the HBM↔host boundary without
+charging the memory ledger leaves the transfer unattributed: the host
+tier's held bytes, the spill/restream counters and the per-tier
+conservation invariant (grants − frees == held) all lie to every
+capacity verdict downstream."""
+
+import numpy as np
+
+
+# analysis: tier-seam
+def spill_page(eng, device_page, host_page):  # VIOLATION
+    payload = eng.gather_page_jit(eng.cache, device_page)
+    eng.host_store[host_page] = np.asarray(payload)
+    eng.spilled_pages += 1
+    return host_page

@@ -63,6 +63,7 @@ class TestCorpusRulesFire:
                 "shipment_seam_ok.py",
                 "shipment-seam",
             ),
+            ("tier_seam_bad.py", "tier_seam_ok.py", "tier-seam"),
             ("kernel_dma_bad.py", "kernel_dma_ok.py", "kernel-dma-balance"),
             ("kernel_ring_bad.py", None, "kernel-ring-order"),
         ],
@@ -89,6 +90,7 @@ class TestCorpusRulesFire:
             ("ledger_seam_bad.py", "ledger-seam"),
             ("memledger_bad.py", "memledger-seam"),
             ("shipment_seam_bad.py", "shipment-seam"),
+            ("tier_seam_bad.py", "tier-seam"),
             ("kernel_ring_bad.py", "kernel-ring-order"),
         ]:
             _, violations = run_static([corpus(name)], rules={rule})
@@ -102,7 +104,7 @@ class TestCorpusRulesFire:
 
     def test_whole_corpus_exactly_one_violation_per_rule(self):
         """The corpus README pin: analyzing the whole corpus directory
-        yields exactly the ten seeded violations — one per static
+        yields exactly the eleven seeded violations — one per static
         rule, nothing from the ok twins."""
         code, violations = run_static([CORPUS])
         assert code == 1
@@ -112,7 +114,7 @@ class TestCorpusRulesFire:
                 "host-sync-in-hot-seam", "jit-in-hot-seam",
                 "determinism-seam", "unlabeled-utilization",
                 "thread-bind", "ledger-seam", "memledger-seam",
-                "shipment-seam", "kernel-dma-balance",
+                "shipment-seam", "tier-seam", "kernel-dma-balance",
                 "kernel-ring-order",
             ]
         ), [v.format() for v in violations]

@@ -265,3 +265,173 @@ def dataclasses_frozen_error():
     import dataclasses
 
     return dataclasses.FrozenInstanceError
+
+
+def _halloc(num_pages=16, page_size=4, pages_per_slot=8, slots=4,
+            host_pages=8):
+    return PageAllocator(num_pages, page_size, pages_per_slot, slots,
+                         host_pages=host_pages)
+
+
+class TestHostTier:
+    """ISSUE 20 cross-tier edges, allocator-side (device payloads are
+    the engine's problem; every id/refcount/reserve transition is
+    pinnable here without jax)."""
+
+    def test_park_of_cow_shared_pages_preserves_sharer_state(self):
+        """Parking a victim whose mapping includes COW-shared pages
+        spills its rows and frees its refcounts/reserve WITHOUT
+        touching the surviving sharer: the sharer's pages stay
+        refcount 1, its index entries stay device-tier, and the
+        victim's COW reserve is returned."""
+        a = _halloc()
+        p = list(range(10))  # 3 pages at ps=4, last partial
+        a.admit(0, p, 4)
+        a.register_prefix(0, p)
+        plan_b = a.admit(1, p + [90, 91], 4)
+        assert plan_b.shared_tokens == 10 and a.reserved == 1
+        shared = list(plan_b.pages[:3])
+        assert all(a.refcount[pg] == 2 for pg in shared)
+        fill = 12  # b's prompt fully prefilled
+        copies, evicted = a.park_pages("b", 1, fill)
+        assert evicted == [] and len(copies) == 3
+        # Spill copies EVERY filled page, shared ones included — the
+        # host copy must be self-contained once slot 1's mapping dies.
+        assert [dp for dp, _ in copies] == list(plan_b.pages[:3])
+        a.free_slot(1)
+        assert all(a.refcount[pg] == 1 for pg in shared)
+        assert a.reserved == 0  # b's COW reserve returned
+        assert a.host_resident_entries == 0  # a's entries untouched
+        assert all(e.tier == "hbm" for e in a._index.values())
+        rec = a.peek_parked("b")
+        assert rec is not None and rec.fill == fill
+        assert len(rec.host_pages) == 3
+        # take AFTER payload consumption recycles the ids.
+        free_before = len(a.host_free)
+        a.take_parked("b")
+        assert len(a.host_free) == free_before + 3
+        assert a.peek_parked("b") is None
+
+    def test_entry_survives_hbm_reclaim_and_confirms_tokens(self):
+        """A sole-reader prefix entry migrates to the host tier when
+        its pages die, keeps serving admits (restream plan, full pages
+        fresh, no cross-tier refcounts), and every host hit is still
+        confirmed by FULL token compare — a poisoned entry can never
+        alias."""
+        import dataclasses as dc
+
+        a = _halloc()
+        p = list(range(8))  # page-aligned: 2 pages
+        a.admit(0, p, 2)
+        a.register_prefix(0, p)
+        copies, evicted = a.spill_prefix_on_free(0)
+        assert evicted == [] and len(copies) == 2
+        a.free_slot(0)
+        assert a.pages_in_use == 0  # HBM fully reclaimed
+        assert a.host_resident_entries == 2  # 4t + 8t boundaries
+        assert a.spilled_prefix_entries == 2
+        plan = a.admit(1, p + [80], 2)
+        assert plan.shared_tokens == 8
+        assert len(plan.restream) == 2  # both prefix pages restream
+        # No cross-tier sharing: every mapped page is fresh + private.
+        assert all(a.refcount[pg] == 1 for pg in plan.pages)
+        assert a.reserved == 0
+        assert a.host_prefix_hits == 1
+        # Restream targets are the mapping's first pages, in order.
+        assert [dp for _, dp in plan.restream] == list(plan.pages[:2])
+        # Poison the longest entry: same hash key, different tokens —
+        # the token compare must refuse the hit.
+        a.free_slot(1)
+        key = max(k for k, e in a._index.items() if e.tier == "host")
+        a._index[key] = dc.replace(a._index[key],
+                                   tokens=tuple(range(100, 108)))
+        plan2 = a.admit(1, p + [80], 2)
+        assert plan2.shared_tokens == 4  # falls back to the 4t entry
+        assert len(plan2.restream) == 1
+
+    def test_promotion_frees_host_copy_on_reregister(self):
+        """register_prefix over a host-resident key promotes it: the
+        entry returns to device pages and the freed host ids are
+        handed back for payload drop."""
+        a = _halloc()
+        p = list(range(8))
+        a.admit(0, p, 2)
+        a.register_prefix(0, p)
+        a.spill_prefix_on_free(0)
+        a.free_slot(0)
+        assert a.host_resident_entries == 2
+        a.admit(1, p, 2)
+        freed = a.register_prefix(1, p)
+        assert a.host_resident_entries == 0
+        assert a.promoted_entries == 2
+        assert len(freed) == 2  # both host pages keyless -> recycled
+        assert len(a.host_free) == a.host_pages
+
+    def test_pool_exhaustion_keeps_all_or_nothing_with_host_hit(self):
+        """A host hit needs the FULL page count fresh (no shared
+        mapping) — when the pool cannot supply it, admit returns None
+        with nothing taken and the host entry keeps serving."""
+        a = _halloc(num_pages=4)
+        p = list(range(8))
+        a.admit(0, p, 2)
+        a.register_prefix(0, p)
+        a.spill_prefix_on_free(0)
+        a.free_slot(0)
+        a.admit(1, [50, 51, 52, 53] * 3, 4)  # 4 pages: pool now full
+        free_before = list(a.free)
+        host_before = list(a.host_free)
+        assert a.admit(2, p + [80], 2) is None
+        assert a.free == free_before
+        assert a.host_free == host_before
+        assert a.host_resident_entries == 2  # entry intact, still hot
+
+    def test_host_exhaustion_spill_is_all_or_nothing(self):
+        """An undersized host tier refuses a park/migration WITHOUT
+        evicting anything first (the reachability check precedes any
+        eviction), and parked records are never reclaimed."""
+        a = _halloc(num_pages=16, host_pages=2)
+        # Park a 2-page victim: host tier now full of promised resumes.
+        a.admit(0, list(range(8)), 2)
+        assert a.park_pages("v", 0, 8) is not None
+        a.free_slot(0)
+        assert a.host_free == []
+        # A second park cannot fit and must not evict the first.
+        a.admit(1, list(range(100, 108)), 2)
+        assert a.park_pages("w", 1, 8) is None
+        assert a.peek_parked("v") is not None
+        # A prefix migration is refused the same way, entries die as
+        # before tiering.
+        a.register_prefix(1, list(range(100, 108)))
+        copies, evicted = a.spill_prefix_on_free(1)
+        assert copies == [] and evicted == []
+        a.free_slot(1)
+        assert a.host_resident_entries == 0
+
+    def test_reclaim_evicts_coldest_prefix_entries_only(self):
+        """Host pressure reclaims the coldest host-resident prefix
+        entries (by last-touch tick) to make room for a park — and
+        hands back their page ids so the engine drops the payloads."""
+        a = _halloc(num_pages=16, host_pages=2)
+        p = list(range(8))
+        a.admit(0, p, 2)
+        a.register_prefix(0, p, tick=1)
+        copies, _ = a.spill_prefix_on_free(0)
+        assert len(copies) == 2
+        a.free_slot(0)
+        assert a.host_resident_entries == 2 and a.host_free == []
+        # Parking now must evict the (cold) entries to fit.
+        a.admit(1, list(range(50, 58)), 2)
+        copies, evicted = a.park_pages("v", 1, 8)
+        assert len(copies) == 2 and len(evicted) == 2
+        assert a.host_resident_entries == 0
+        assert a.parked_spills == 1
+
+    def test_drop_parked_returns_ids_for_payload_drop(self):
+        a = _halloc()
+        a.admit(0, list(range(8)), 2)
+        copies, _ = a.park_pages("v", 0, 8)
+        a.free_slot(0)
+        freed = a.drop_parked("v")
+        assert sorted(freed) == sorted(hp for _, hp in copies)
+        assert len(a.host_free) == a.host_pages
+        assert a.drop_parked("v") == []  # idempotent

@@ -262,6 +262,39 @@ class TestCapacityVerdict:
         text = format_capacity(rep)
         assert "conservation: ok" in text and "weights" in text
 
+    def test_report_names_host_tier_and_pressure(self):
+        """ISSUE 20: a tiered stats block yields a host line, tiered
+        eviction candidates, and an exhaustion verdict naming whether
+        pressure is HBM-only or both tiers; a pre-tiering snapshot
+        (``_snap``) keeps reporting with no host line at all."""
+        mem = {
+            "source": "memledger", "platform": "cpu",
+            "held_bytes": 6000, "held_peak_bytes": 6000,
+            "held_by_subsystem": {"kv_pages": 600, "weights": 5000},
+            "conservation": {"ok": True},
+            "kv_capacity_bytes": 800,
+            "host_held_bytes": 4096, "host_capacity_bytes": 8192,
+            "host_held_peak_bytes": 6144,
+            "eviction_candidates": [
+                {"kind": "host_prefix", "key": "prefix[16t]",
+                 "bytes": 4096, "last_touch_tick": 3, "tier": "host"},
+            ],
+            "exhaustion": {"tick": 9, "kv_headroom_bytes": 0,
+                           "tier_pressure": "both_tiers"},
+        }
+        rep = capacity_report({"memory": mem})
+        assert rep["host_held_bytes"] == 4096
+        assert rep["host_capacity_bytes"] == 8192
+        assert rep["host_held_peak_bytes"] == 6144
+        text = format_capacity(rep)
+        assert "host tier held 4.0KiB of 8.0KiB (50.0%)" in text
+        assert "tier=host" in text
+        assert "pressure=both_tiers" in text
+        # Pre-tiering snapshot: no host subsystem, no host line.
+        pre = capacity_report(self._snap())
+        assert "host_held_bytes" not in pre
+        assert "host tier" not in format_capacity(pre)
+
     def test_report_refuses_docs_without_ledger_data(self):
         with pytest.raises(ValueError):
             capacity_report({"phases": {}})
@@ -323,6 +356,52 @@ class TestBaselineMemoryGate:
             {"phases": {}}, memory={"held_peak_bytes": None}
         )
         assert "memory" not in s
+
+    # -- host-tier keys (ISSUE 20) ---------------------------------------
+    def _host_snap(self, peak, host_peak, restream=4096):
+        return baseline.snapshot(
+            {"phases": {"decode": {"count": 1, "total_s": 1.0,
+                                   "p50_s": 1.0, "p95_s": 1.0}}},
+            memory={"held_peak_bytes": peak, "platform": "cpu",
+                    "host_held_peak_bytes": host_peak,
+                    "restream_bytes": restream},
+        )
+
+    def test_host_peak_growth_beyond_tolerance_trips_gate(self):
+        """Host-tier peak growth is a spill leak — granted at dispatch,
+        never released — and gates exactly like the HBM peak."""
+        verdict = baseline.diff(
+            self._host_snap(1000, 2000), self._host_snap(1000, 2600),
+            tolerance_pct=10.0,
+        )
+        assert not verdict["ok"]
+        assert verdict["memory_regressions"] == [
+            "memory.host_held_peak_bytes"
+        ]
+        assert verdict["memory"]["host_held_peak_bytes"][
+            "growth_pct"] == 30.0
+        # restream bytes ride along as context, never gate.
+        assert verdict["memory"]["restream_bytes"] == {
+            "base": 4096, "cur": 4096,
+        }
+
+    def test_pre_tiering_baseline_never_gates_host_keys(self):
+        """A pre-ISSUE-20 baseline has no host keys: the diff must not
+        manufacture a host verdict from one side (the HBM keys' own
+        never-gate-vacuously rule, extended)."""
+        verdict = baseline.diff(
+            self._snap(1000), self._host_snap(1000, 99999999),
+            tolerance_pct=10.0,
+        )
+        assert verdict["ok"]
+        assert "host_held_peak_bytes" not in verdict.get("memory", {})
+        # And a zero-peak base (tiering on, nothing ever spilled)
+        # stays ungated too — growth from 0 is undefined, not infinite.
+        verdict = baseline.diff(
+            self._host_snap(1000, 0), self._host_snap(1000, 8192),
+            tolerance_pct=10.0,
+        )
+        assert verdict["ok"]
 
 
 # ---------------------------------------------------------------------------
